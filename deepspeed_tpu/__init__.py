@@ -1,0 +1,49 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capability surface of DeepSpeed (reference v0.7.3), built on JAX/XLA/Pallas.
+
+Public API parity with ``deepspeed/__init__.py``: ``initialize`` (:51),
+``init_inference`` (:225), ``add_config_arguments`` (:209), plus the module
+namespaces (``comm``, ``zero``, ``moe``, ``ops``...).
+"""
+
+from .version import __version__  # noqa: F401
+
+from . import comm  # noqa: F401
+from . import parallel  # noqa: F401
+from .utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Build a training engine. See ``deepspeed_tpu.runtime.engine``.
+
+    Reference: ``deepspeed/__init__.py:51`` — returns
+    ``(engine, optimizer, dataloader, lr_scheduler)``.
+    """
+    from .runtime.engine import initialize as _initialize
+
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Build an inference engine. Reference: ``deepspeed/__init__.py:225``."""
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Reference: ``deepspeed/__init__.py:209``."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for argument parsing)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS

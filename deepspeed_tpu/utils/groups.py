@@ -1,0 +1,59 @@
+"""Parallel-group size/rank queries.
+
+API-parity layer for the reference's ``deepspeed/utils/groups.py`` (e.g.
+``_get_data_parallel_world_size``, ``_get_expert_parallel_ranks`` :163). Under
+SPMD there are no process-group handles — a "group" is just a named mesh axis,
+and rank-in-group is the device's coordinate along that axis. These helpers
+answer the same questions from the current mesh topology.
+"""
+
+from typing import Tuple
+
+from ..parallel import topology as topo
+
+
+def _sizes() -> topo.MeshTopology:
+    t = topo.get_topology()
+    if t is None:
+        # No mesh initialized → single device semantics.
+        return topo.MeshTopology(pipe=1, data=1, expert=1, seq=1, model=1)
+    return t
+
+
+def get_data_parallel_world_size() -> int:
+    """Reference semantics: includes expert & sequence axes (world/(mp*pp))."""
+    return _sizes().dp_world_size
+
+
+def get_model_parallel_world_size() -> int:
+    return _sizes().model
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _sizes().pipe
+
+
+def get_expert_parallel_world_size() -> int:
+    return _sizes().expert
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _sizes().seq
+
+
+def get_expert_data_parallel_world_size() -> int:
+    """Reference ``_get_expert_data_parallel_group``: dp / ep."""
+    t = _sizes()
+    return t.data * t.seq
+
+
+def get_world_size() -> int:
+    return _sizes().world_size
+
+
+def zero_axes() -> Tuple[str, ...]:
+    return topo.ZERO_AXES
+
+
+def batch_axes() -> Tuple[str, ...]:
+    return topo.BATCH_AXES

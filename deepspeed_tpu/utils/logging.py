@@ -1,0 +1,62 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``:
+``log_dist`` filters by process index (JAX multi-host) instead of torch ranks.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    if logger_.handlers:
+        return logger_
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger_.addHandler(handler)
+    return logger_
+
+
+logger = create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DEEPSPEED_TPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time; logging must be importable
+    # before jax.distributed initialization.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (None or [-1] = all)."""
+    rank = _process_index()
+    should_log = ranks is None or any(r in (-1, rank) for r in ranks)
+    if should_log:
+        logger.log(level, f"[Rank {rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

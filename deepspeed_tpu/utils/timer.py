@@ -1,0 +1,183 @@
+"""Wall-clock + throughput timers.
+
+Counterpart of the reference's ``deepspeed/utils/timer.py`` (CUDA-event
+``SynchronizedWallClockTimer`` and ``ThroughputTimer``). On TPU there are no
+CUDA events; synchronization is ``jax.block_until_ready`` on a token array (or
+any outstanding computation), which drains the dispatch queue the same way
+``torch.cuda.synchronize`` does.
+"""
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+try:
+    import psutil
+
+    _PSUTIL = True
+except Exception:  # pragma: no cover
+    _PSUTIL = False
+
+
+def _synchronize() -> None:
+    """Block until all dispatched device computations are complete."""
+    import jax
+
+    try:
+        # Effectively a device fence: a trivial computation ordered after all
+        # previously enqueued work on the default device.
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+
+
+class Timer:
+    """A single named timer with optional device synchronization."""
+
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.synchronize = synchronize
+        self.started = False
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._record: List[float] = []
+
+    def start(self) -> None:
+        if self.started:
+            return
+        if self.synchronize:
+            _synchronize()
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, record: bool = True) -> None:
+        if not self.started:
+            return
+        if self.synchronize:
+            _synchronize()
+        elapsed = time.perf_counter() - self._start_time
+        self._elapsed += elapsed
+        if record:
+            self._record.append(elapsed)
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self._record = []
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Total elapsed seconds (stops/restarts a running timer)."""
+        was_started = self.started
+        if was_started:
+            self.stop(record=False)
+        total = self._elapsed
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return total
+
+    def mean(self) -> float:
+        return sum(self._record) / len(self._record) if self._record else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry (reference: ``utils/timer.py:31``)."""
+
+    def __init__(self):
+        self.timers: "OrderedDict[str, Timer]" = OrderedDict()
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        if not _PSUTIL:
+            return "mem: n/a"
+        vm = psutil.virtual_memory()
+        return f"host mem used: {vm.used / 2**30:.2f} GB ({vm.percent}%)"
+
+    def log(self, names: Optional[List[str]] = None, normalizer: float = 1.0, reset: bool = True,
+            ranks=None) -> None:
+        names = names if names is not None else list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names
+            if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs tracker (reference: ``utils/timer.py:135``)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self) -> None:
+        self.local_step_count = 0
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _synchronize()
+            self._start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+            self.local_step_count += 1
+        if self.global_step_count > self.start_step:
+            _synchronize()
+            duration = time.perf_counter() - self._start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec (avg)={self.avg_samples_per_sec():.2f}, "
+                    f"samples/sec (recent)={self.recent_samples_per_sec():.2f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        steps = self.global_step_count - self.start_step
+        if steps > 0 and self.total_elapsed_time > 0:
+            return self.batch_size / (self.total_elapsed_time / steps)
+        return 0.0
+
+    def recent_samples_per_sec(self) -> float:
+        window = self.global_step_count % self.steps_per_output or self.steps_per_output
+        if self.step_elapsed_time > 0:
+            return self.batch_size * window / self.step_elapsed_time
+        return 0.0

@@ -1,0 +1,45 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+TPU translation of the reference's multi-process ``DistributedTest`` harness
+(``tests/unit/common.py:67`` forks N NCCL processes): we instead give one
+process 8 virtual XLA CPU devices and exercise real SPMD sharding/collectives
+on them. Must set env BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env presets a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The sandbox pre-imports jax via sitecustomize before env vars can take
+# effect; the backend is still uninitialized at conftest time, so switch via
+# jax.config instead.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Isolate tests from each other's global mesh state."""
+    yield
+    from deepspeed_tpu.parallel import topology
+
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.parallel import build_mesh
+
+    return build_mesh(data=8)
+
+
+def pytest_report_header(config):
+    return f"jax {jax.__version__} | devices: {jax.device_count()} ({jax.devices()[0].platform})"

@@ -1,0 +1,66 @@
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import (
+    MESH_AXES,
+    MeshTopology,
+    build_mesh,
+    get_mesh,
+    get_topology,
+    set_mesh,
+)
+from deepspeed_tpu.utils import groups
+
+
+def test_resolve_infers_data_axis():
+    topo = MeshTopology(model=2).resolve(8)
+    assert topo.data == 4
+    assert topo.world_size == 8
+
+
+def test_resolve_rejects_bad_world():
+    with pytest.raises(ValueError):
+        MeshTopology(model=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshTopology(data=3, model=2).resolve(8)
+
+
+def test_build_mesh_axis_names():
+    mesh = build_mesh(data=4, model=2)
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.devices.size == 8
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert shape["data"] == 4 and shape["model"] == 2
+
+
+def test_build_mesh_full_3d():
+    mesh = build_mesh(pipe=2, data=2, model=2)
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert shape == {"pipe": 2, "data": 2, "expert": 1, "seq": 1, "model": 2}
+
+
+def test_global_registry_and_groups():
+    mesh = build_mesh(pipe=2, data=2, expert=1, seq=1, model=2)
+    set_mesh(mesh)
+    assert get_mesh() is mesh
+    topo = get_topology()
+    assert topo.pipe == 2
+    # dp_world_size includes expert & seq axes (reference semantics)
+    assert groups.get_data_parallel_world_size() == 2
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_pipe_parallel_world_size() == 2
+    assert groups.get_world_size() == 8
+
+
+def test_groups_default_single_device():
+    assert groups.get_data_parallel_world_size() == 1
+    assert groups.get_world_size() == 1
+
+
+def test_expert_axis_subdivides_dp():
+    mesh = build_mesh(data=2, expert=4)
+    set_mesh(mesh)
+    assert groups.get_expert_parallel_world_size() == 4
+    assert groups.get_data_parallel_world_size() == 8
+    assert groups.get_expert_data_parallel_world_size() == 2
