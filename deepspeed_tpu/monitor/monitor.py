@@ -1,0 +1,121 @@
+"""Experiment monitoring: TensorBoard / W&B / CSV fan-out.
+
+Counterpart of ``deepspeed/monitor/monitor.py:24`` (``MonitorMaster``) and the
+per-backend writers (``tensorboard.py:8``, ``wandb.py:7``, ``csv_monitor.py:7``).
+Events are ``(tag, value, step)`` tuples, written only from process 0 of the
+job (the reference gates on global rank 0).
+"""
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """Reference: ``monitor/tensorboard.py:8``."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except Exception:
+                logger.warning("tensorboard not available; disabling TensorBoardMonitor")
+                self.enabled = False
+                return
+        log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list: List[Event], flush: bool = True) -> None:
+        if not (self.enabled and self.summary_writer):
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """Reference: ``monitor/wandb.py:7``."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if not self.enabled:
+            return
+        try:
+            import wandb  # type: ignore
+
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+            self._wandb = wandb
+        except Exception:
+            logger.warning("wandb not available; disabling WandbMonitor")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not (self.enabled and self._wandb):
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    """Reference: ``monitor/csv_monitor.py:7`` (name kept for parity)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.filenames = {}
+        if self.enabled:
+            self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(self.log_dir, name.replace("/", "_") + ".csv")
+            is_new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if is_new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Reference: ``monitor/monitor.py:24`` — fans out to all enabled
+    backends; only process 0 writes."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        import jax
+
+        if jax.process_index() != 0 or not event_list:
+            return
+        self.tb_monitor.write_events(event_list)
+        self.wandb_monitor.write_events(event_list)
+        self.csv_monitor.write_events(event_list)

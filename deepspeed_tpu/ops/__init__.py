@@ -1,0 +1,1 @@
+from .optimizers import FusedAdam, FusedLamb, DeepSpeedCPUAdam, get_optimizer  # noqa: F401

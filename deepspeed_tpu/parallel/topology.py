@@ -105,14 +105,18 @@ def build_mesh(topology: Optional[MeshTopology] = None,
     topology = topology.resolve(len(devices))
 
     sizes = topology.axis_sizes()
+    # Auto axis types: the XLA SPMD partitioner owns resharding decisions
+    # (our design premise — collectives are inserted by the compiler, not
+    # spelled per-op as jax 0.9's Explicit mode would require).
+    axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
     if default_devices:
         # jax.make_mesh lays axes onto the physical ICI topology.
         try:
-            return jax.make_mesh(sizes, MESH_AXES)
+            return jax.make_mesh(sizes, MESH_AXES, axis_types=axis_types)
         except Exception:
             pass
     mesh_devices = np.asarray(devices).reshape(sizes)
-    return Mesh(mesh_devices, MESH_AXES)
+    return Mesh(mesh_devices, MESH_AXES, axis_types=axis_types)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,351 @@
+"""The single-JSON config system.
+
+Parity with ``deepspeed/runtime/config.py:699`` (``DeepSpeedConfig``): one JSON
+file or dict configures the whole engine — batch-size triangulation
+(train = micro × gas × dp, reference ``config.py:897``), precision, optimizer,
+scheduler, ZeRO, and every aux subsystem. TPU-specific extension: a
+``"parallel"`` block sizing the named mesh axes (the reference gets mp/pp
+sizes from an external ``mpu``; our mesh is first-class).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from ..parallel.topology import MeshTopology
+from ..utils.logging import logger
+from .config_utils import AUTO, DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
+from .zero.config import DeepSpeedZeroConfig
+
+# ---------------------------------------------------------------------------
+# Sub-configs
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """Reference: fp16 dict in ``runtime/config.py`` + ``fp16/loss_scaler.py``."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference: ``runtime/activation_checkpointing/config.py``."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    """Reference: ``deepspeed/comm/config.py:10``."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class CurriculumConfig(DeepSpeedConfigModel):
+    """Reference: ``runtime/data_pipeline/curriculum_scheduler.py``."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    """Reference: aio dict (``csrc/aio`` handle params)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    """Reference: ``deepspeed/elasticity/config.py``."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: Optional[str] = "autotuning_results"
+    exps_dir: Optional[str] = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    arg_mappings: Optional[Dict[str, str]] = None
+
+
+class ParallelConfig(DeepSpeedConfigModel):
+    """TPU extension: named mesh axis sizes. -1 on data = absorb remaining."""
+
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def topology(self) -> MeshTopology:
+        return MeshTopology(pipe=self.pipe, data=self.data, expert=self.expert,
+                            seq=self.seq, model=self.model)
+
+
+class QuantizeTrainingConfig(DeepSpeedConfigModel):
+    """MoQ — reference ``runtime/quantize.py`` config block."""
+
+    enabled: bool = False
+    quantize_verbose: bool = False
+    quantizer_kernel: bool = False
+    quantize_type: str = "symmetric"
+    quantize_bits: Dict[str, int] = Field(
+        default_factory=lambda: {"start_bits": 16, "target_bits": 8})
+    quantize_schedule: Dict[str, Any] = Field(default_factory=dict)
+    quantize_groups: int = 1
+    fp16_mixed_quantize: Dict[str, Any] = Field(default_factory=dict)
+    eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
+
+
+# ---------------------------------------------------------------------------
+# Top-level config
+# ---------------------------------------------------------------------------
+
+GRADIENT_CLIPPING_DEFAULT = 0.0
+STEPS_PER_PRINT_DEFAULT = 10
+
+
+class DeepSpeedConfig:
+    """Reference: ``deepspeed/runtime/config.py:699``.
+
+    ``config`` may be a path to JSON or a dict. ``world_size`` here means the
+    data-parallel world (reference passes ``dist.get_world_size()`` of the dp
+    group) used for batch triangulation.
+    """
+
+    def __init__(self, config: Union[str, Dict], world_size: Optional[int] = None):
+        if isinstance(config, (str, os.PathLike)):
+            with open(config, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ValueError(f"Expected a string path or dict, got: {config!r}")
+
+        self.world_size = world_size if world_size is not None else 1
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- parsing ----------------------------------------------------------
+
+    def _initialize_params(self, pd: Dict) -> None:
+        get = pd.get
+        self.train_batch_size = _auto_none(get("train_batch_size"))
+        self.train_micro_batch_size_per_gpu = _auto_none(get("train_micro_batch_size_per_gpu"))
+        self.gradient_accumulation_steps = _auto_none(get("gradient_accumulation_steps"))
+
+        self.steps_per_print = get("steps_per_print", STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get("dump_state", False)
+        self.gradient_clipping = _auto_default(get("gradient_clipping"),
+                                               GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get("prescale_gradients", False)
+        self.gradient_predivide_factor = get("gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled = get("sparse_gradients", False)
+        self.communication_data_type = get("communication_data_type", None)
+        self.disable_allgather = get("disable_allgather", False)
+        self.memory_breakdown = get("memory_breakdown", False)
+        self.wall_clock_breakdown = get("wall_clock_breakdown", False)
+
+        self.fp16 = FP16Config(**get("fp16", {}))
+        self.bf16 = BF16Config(**get("bf16", get("bfloat16", {})))
+        if get("amp", {}).get("enabled", False):
+            logger.warning("amp is a CUDA-specific subsystem; on TPU use bf16 "
+                           "(recommended) or fp16. Ignoring the amp block.")
+        self.optimizer = OptimizerConfig(**get("optimizer")) if get("optimizer") else None
+        self.scheduler = SchedulerConfig(**get("scheduler")) if get("scheduler") else None
+        self.zero_config = DeepSpeedZeroConfig(**get("zero_optimization", {}))
+        self.zero_optimization_stage = int(self.zero_config.stage)
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **get("activation_checkpointing", {}))
+        self.flops_profiler = FlopsProfilerConfig(**get("flops_profiler", {}))
+        self.tensorboard = TensorBoardConfig(**get("tensorboard", {}))
+        self.wandb = WandbConfig(**get("wandb", {}))
+        self.csv_monitor = CSVConfig(**get("csv_monitor", {}))
+        self.comms_logger = CommsLoggerConfig(**get("comms_logger", {}))
+        self.curriculum_learning = CurriculumConfig(**get("curriculum_learning", {}))
+        self.progressive_layer_drop = ProgressiveLayerDropConfig(
+            **get("progressive_layer_drop", {}))
+        self.aio = AIOConfig(**get("aio", {}))
+        self.elasticity = ElasticityConfig(**get("elasticity", {}))
+        self.autotuning = AutotuningConfig(**get("autotuning", {}))
+        self.quantize_training = QuantizeTrainingConfig(**get("quantize_training", {}))
+        self.parallel = ParallelConfig(**get("parallel", {}))
+        self.compression_config = get("compression_training", {})
+        self.checkpoint = get("checkpoint", {})
+        self.load_universal_checkpoint = get("checkpoint", {}).get("load_universal", False)
+        self.use_node_local_storage = get("checkpoint", {}).get("use_node_local_storage", False)
+        self.seed = get("seed", 1234)
+
+    # -- batch triangulation (reference config.py:799-815, :897) ----------
+
+    def _configure_train_batch_size(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        dp = max(1, self.world_size)
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+        elif train is not None and gas is not None:
+            micro = train // (dp * gas)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            micro = train // dp
+        elif micro is not None:
+            train = micro * dp
+            gas = 1
+        else:
+            raise ValueError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _batch_assertion(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train > 0, f"Train batch size: {train} has to be greater than 0"
+        assert micro > 0, f"Micro batch size per gpu: {micro} has to be greater than 0"
+        assert gas > 0, f"Gradient accumulation steps: {gas} has to be greater than 0"
+        assert train == micro * gas * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train} != {micro} * {gas} * {self.world_size}")
+
+    def _do_sanity_check(self) -> None:
+        self._batch_assertion()
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_enabled and not (self.fp16.enabled or self.bf16.enabled):
+            logger.info("ZeRO with full-precision master weights (fp32 compute)")
+
+    # -- misc -------------------------------------------------------------
+
+    @property
+    def precision(self) -> str:
+        if self.bf16.enabled:
+            return "bf16"
+        if self.fp16.enabled:
+            return "fp16"
+        return "fp32"
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True, default=str))
+
+
+def _auto_none(v):
+    return None if (v is None or v == AUTO) else v
+
+
+def _auto_default(v, default):
+    return default if (v is None or v == AUTO) else v

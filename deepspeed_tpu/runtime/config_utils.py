@@ -1,0 +1,70 @@
+"""Config plumbing shared by every sub-config.
+
+Counterpart of ``deepspeed/runtime/config_utils.py:15`` (``DeepSpeedConfigModel``):
+a pydantic base that supports the reference's ``"auto"`` sentinel passthrough
+(:49-54) and deprecated-field migration machinery.
+"""
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+AUTO = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Pydantic base for all config blocks.
+
+    Fields may carry ``json_schema_extra={"deprecated": True, "new_param":
+    "x"}`` to migrate old names, mirroring the reference's
+    ``_process_deprecated_field``.
+    """
+
+    model_config = ConfigDict(extra="allow", validate_assignment=True,
+                              arbitrary_types_allowed=True, populate_by_name=True,
+                              protected_namespaces=())
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:  # drop "auto" values so defaults apply (reference :49)
+            data = {k: v for k, v in data.items()
+                    if not (isinstance(v, str) and v == AUTO)}
+        super().__init__(**data)
+        self._migrate_deprecated(data)
+
+    def _migrate_deprecated(self, data: Dict[str, Any]) -> None:
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            new_param = extra.get("new_param")
+            if name in data and new_param:
+                from ..utils.logging import logger
+
+                logger.warning(f"Config parameter {name} is deprecated, use {new_param}")
+                if data.get(new_param) is None or new_param not in data:
+                    try:
+                        setattr(self, new_param, getattr(self, name))
+                    except Exception:
+                        # value shapes differ (e.g. bool flag -> sub-config);
+                        # the owning config class translates it explicitly.
+                        pass
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the JSON (reference ``config_utils.py``)."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d

@@ -1,0 +1,93 @@
+"""Static + dynamic loss scaling for fp16 training.
+
+Counterpart of ``deepspeed/runtime/fp16/loss_scaler.py:54`` (``LossScaler`` /
+``DynamicLossScaler``). Design departure: the reference mutates Python state
+between CUDA launches; here the scaler state is a JAX pytree updated inside
+the compiled train step (``jnp.where`` branches), so scale adjustment costs
+nothing and never breaks the jit cache.
+"""
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class LossScaleState:
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_iter: jnp.ndarray  # i32: steps since last overflow
+    cur_hysteresis: jnp.ndarray  # i32
+
+    # static config
+    static: bool = struct.field(pytree_node=False, default=False)
+    scale_factor: float = struct.field(pytree_node=False, default=2.0)
+    scale_window: int = struct.field(pytree_node=False, default=1000)
+    min_scale: float = struct.field(pytree_node=False, default=1.0)
+    hysteresis: int = struct.field(pytree_node=False, default=2)
+
+
+def create_loss_scaler(fp16_config=None, static_scale: float = None) -> LossScaleState:
+    """Build scaler state from an ``FP16Config`` (reference semantics:
+    ``loss_scale == 0`` → dynamic, else static)."""
+    if fp16_config is not None and fp16_config.loss_scale:
+        static_scale = fp16_config.loss_scale
+    if static_scale is not None:
+        return LossScaleState(cur_scale=jnp.float32(static_scale), cur_iter=jnp.int32(0),
+                              cur_hysteresis=jnp.int32(1), static=True)
+    cfg = fp16_config
+    return LossScaleState(
+        cur_scale=jnp.float32(2.0 ** (cfg.initial_scale_power if cfg else 16)),
+        cur_iter=jnp.int32(0),
+        cur_hysteresis=jnp.int32(cfg.hysteresis if cfg else 2),
+        static=False,
+        scale_window=cfg.loss_scale_window if cfg else 1000,
+        min_scale=cfg.min_loss_scale if cfg else 1.0,
+        hysteresis=cfg.hysteresis if cfg else 2,
+    )
+
+
+def has_inf_or_nan(x: jnp.ndarray) -> jnp.ndarray:
+    """Reference: ``loss_scaler.py:73`` ``_has_inf_or_nan``."""
+    return ~jnp.isfinite(x.astype(jnp.float32)).all()
+
+
+def tree_overflow(grads: Any) -> jnp.ndarray:
+    """True if any leaf contains inf/nan (the global overflow check the
+    reference does with ``CheckOverflow``)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [has_inf_or_nan(leaf) for leaf in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState:
+    """One step of the dynamic loss-scale automaton (reference
+    ``DynamicLossScaler.update_scale``): halve on overflow (respecting
+    hysteresis), double after ``scale_window`` clean steps."""
+    if state.static:
+        return state
+
+    # overflow path
+    hysteresis_spent = state.cur_hysteresis <= 1
+    new_scale_overflow = jnp.where(
+        hysteresis_spent,
+        jnp.maximum(state.cur_scale / state.scale_factor, state.min_scale),
+        state.cur_scale)
+    new_hyst_overflow = jnp.where(hysteresis_spent, state.cur_hysteresis,
+                                  state.cur_hysteresis - 1)
+
+    # clean path
+    window_done = (state.cur_iter + 1) % state.scale_window == 0
+    new_scale_clean = jnp.where(window_done, state.cur_scale * state.scale_factor,
+                                state.cur_scale)
+
+    return state.replace(
+        cur_scale=jnp.where(overflow, new_scale_overflow, new_scale_clean),
+        cur_hysteresis=jnp.where(overflow, new_hyst_overflow,
+                                 jnp.int32(state.hysteresis)),
+        cur_iter=jnp.where(overflow, jnp.int32(0), state.cur_iter + 1),
+    )

@@ -1,0 +1,136 @@
+"""LR schedules with the reference's names and semantics.
+
+Counterpart of ``deepspeed/runtime/lr_schedules.py``: ``LRRangeTest`` (:308),
+``OneCycle`` (:415), ``WarmupLR`` (:704), ``WarmupDecayLR`` (:800). Here each
+schedule is a pure ``step -> lr`` callable (optax-style), which the engine
+feeds into the optimizer; the OneCycle momentum leg is exposed via
+``get_mom`` and consumed by the optimizer factory when supported.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+VALID_LR_SCHEDULES = ["LRRangeTest", "OneCycle", "WarmupLR", "WarmupDecayLR"]
+
+
+class WarmupLR:
+    """Reference :704 — warmup then hold at ``warmup_max_lr``."""
+
+    def __init__(self, warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000, warmup_type: str = "log", **_):
+        self.warmup_min_lr = warmup_min_lr
+        self.warmup_max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        if warmup_type not in ("log", "linear"):
+            raise ValueError(f"warmup_type {warmup_type} not in (log, linear)")
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.warmup_type == "log":
+            gamma = self.inverse_log_warm_up * jnp.log(jnp.maximum(step, 1.0))
+        else:
+            gamma = step / self.warmup_num_steps
+        gamma = jnp.clip(gamma, 0.0, 1.0)
+        return self.warmup_min_lr + (self.warmup_max_lr - self.warmup_min_lr) * gamma
+
+
+class WarmupDecayLR(WarmupLR):
+    """Reference :800 — warmup then linear decay to 0 at ``total_num_steps``."""
+
+    def __init__(self, total_num_steps: int = 10000, **kwargs):
+        super().__init__(**kwargs)
+        self.total_num_steps = max(2, total_num_steps)
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = super().__call__(step)
+        decay_frac = (self.total_num_steps - step) / jnp.maximum(
+            1.0, self.total_num_steps - self.warmup_num_steps)
+        decay = self.warmup_max_lr * jnp.clip(decay_frac, 0.0, 1.0)
+        return jnp.where(step < self.warmup_num_steps, warm, decay)
+
+
+class OneCycle:
+    """Reference :415 — triangular cycle then decay; momentum cycles inversely."""
+
+    def __init__(self, cycle_min_lr: float = 0.0, cycle_max_lr: float = 0.001,
+                 decay_lr_rate: float = 0.0, cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0, cycle_momentum: bool = True,
+                 cycle_min_mom: float = 0.85, cycle_max_mom: float = 0.99,
+                 decay_mom_rate: float = 0.0, last_batch_iteration: int = -1, **_):
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first = float(cycle_first_step_size)
+        self.second = float(cycle_second_step_size
+                            if cycle_second_step_size is not None else cycle_first_step_size)
+        self.decay_step_size = float(decay_step_size)
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+        self.total_size = self.first + self.second
+
+    def _cycle_phase(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        in_up = step <= self.first
+        up_frac = step / jnp.maximum(self.first, 1.0)
+        down_frac = 1.0 - (step - self.first) / jnp.maximum(self.second, 1.0)
+        frac = jnp.where(in_up, up_frac, down_frac)
+        return jnp.clip(frac, 0.0, 1.0), step > self.total_size
+
+    def __call__(self, step):
+        frac, in_decay = self._cycle_phase(step)
+        cyc = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * frac
+        if self.decay_step_size > 0:
+            decay_steps = (jnp.asarray(step, jnp.float32) - self.total_size) / self.decay_step_size
+            dec = self.cycle_min_lr / (1.0 + jnp.maximum(decay_steps, 0.0) * self.decay_lr_rate)
+        else:
+            dec = jnp.full_like(cyc, self.cycle_min_lr)
+        return jnp.where(in_decay, dec, cyc)
+
+    def get_mom(self, step):
+        if not self.cycle_momentum:
+            return None
+        frac, in_decay = self._cycle_phase(step)
+        cyc = self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * frac
+        return jnp.where(in_decay, self.cycle_max_mom, cyc)
+
+
+class LRRangeTest:
+    """Reference :308 — LR sweep for finding stable ranges."""
+
+    def __init__(self, lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False, **_):
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = max(1, lr_range_test_step_size)
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = jnp.floor(step / self.step_size) if self.staircase else step / self.step_size
+        return self.min_lr * (1.0 + interval * self.step_rate)
+
+
+SCHEDULE_REGISTRY: Dict[str, Any] = {
+    "WarmupLR": WarmupLR,
+    "WarmupDecayLR": WarmupDecayLR,
+    "OneCycle": OneCycle,
+    "LRRangeTest": LRRangeTest,
+}
+
+
+def get_lr_schedule(name: Optional[str], params: Dict[str, Any],
+                    base_lr: float = None) -> Optional[Callable]:
+    if name is None:
+        return None
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"Unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    return SCHEDULE_REGISTRY[name](**params)

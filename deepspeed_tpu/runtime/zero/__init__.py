@@ -1,0 +1,8 @@
+from .config import DeepSpeedZeroConfig, ZeroStageEnum  # noqa: F401
+from .partition import (  # noqa: F401
+    Init,
+    GatheredParameters,
+    partition_spec_for_param,
+    shard_params,
+    state_shardings,
+)

@@ -1,0 +1,98 @@
+"""ZeRO configuration.
+
+Parity with ``deepspeed/runtime/zero/config.py:77`` (``DeepSpeedZeroConfig``)
+and ``offload_config.py``. On TPU, stages map to sharding policies (see
+``deepspeed_tpu/runtime/zero/partition.py``); knobs that only steer CUDA
+stream overlap are accepted for config compatibility and noted as no-ops
+(XLA's latency-hiding scheduler owns overlap).
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class ZeroStageEnum(int, Enum):
+    """Reference: ``zero/config.py:69``."""
+
+    disabled = 0
+    optimizer_states = 1
+    gradients = 2
+    weights = 3
+    max_stage = 3
+
+
+class OffloadDeviceEnum(str, Enum):
+    """Reference: ``zero/offload_config.py``."""
+
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(100_000_000, ge=0)
+    max_in_cpu: int = Field(1_000_000_000, ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """Reference: ``zero/config.py:77-137``."""
+
+    stage: ZeroStageEnum = ZeroStageEnum.disabled
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(500_000_000, ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(500_000_000, ge=0)
+    overlap_comm: Optional[bool] = None  # default True for stage3 (reference)
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = Field(1_000_000_000, ge=0)
+    cpu_offload_param: Optional[bool] = Field(None, json_schema_extra={
+        "deprecated": True, "new_param": "offload_param"})
+    cpu_offload_use_pin_memory: Optional[bool] = None
+    cpu_offload: Optional[bool] = Field(None, json_schema_extra={
+        "deprecated": True, "new_param": "offload_optimizer"})
+    prefetch_bucket_size: int = Field(50_000_000, ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(100_000, ge=0,
+                                             alias="stage3_param_persistence_threshold")
+    max_live_parameters: int = Field(1_000_000_000, ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(1_000_000_000, ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(
+        False, alias="stage3_gather_16bit_weights_on_model_save")
+    stage3_gather_fp16_weights_on_model_save: Optional[bool] = Field(None, json_schema_extra={
+        "deprecated": True, "new_param": "gather_16bit_weights_on_model_save"})
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    def __init__(self, **data):
+        # honor either alias or field name
+        super().__init__(**data)
+        if self.overlap_comm is None:
+            self.overlap_comm = self.stage == ZeroStageEnum.weights
+        if self.cpu_offload:
+            self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
+                device=OffloadDeviceEnum.cpu, pin_memory=bool(self.cpu_offload_use_pin_memory))
+        if self.cpu_offload_param:
+            self.offload_param = DeepSpeedZeroOffloadParamConfig(
+                device=OffloadDeviceEnum.cpu, pin_memory=bool(self.cpu_offload_use_pin_memory))

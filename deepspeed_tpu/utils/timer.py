@@ -162,7 +162,8 @@ class ThroughputTimer:
             duration = time.perf_counter() - self._start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
-            if report_speed and self.global_step_count % self.steps_per_output == 0:
+            if report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
                 self.logging(
                     f"step={self.global_step_count}, "
                     f"samples/sec (avg)={self.avg_samples_per_sec():.2f}, "
@@ -177,6 +178,8 @@ class ThroughputTimer:
         return 0.0
 
     def recent_samples_per_sec(self) -> float:
+        if not self.steps_per_output:
+            return self.avg_samples_per_sec()
         window = self.global_step_count % self.steps_per_output or self.steps_per_output
         if self.step_elapsed_time > 0:
             return self.batch_size * window / self.step_elapsed_time

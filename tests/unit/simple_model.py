@@ -1,0 +1,39 @@
+"""Tiny model fixtures (counterpart of the reference's
+``tests/unit/simple_model.py`` — ``SimpleModel`` :12 etc.)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y):
+        h = x
+        for _ in range(self.nlayers):
+            h = nn.Dense(self.hidden_dim)(h)
+            h = nn.relu(h)
+        out = nn.Dense(1)(h)
+        loss = jnp.mean((out.squeeze(-1) - y) ** 2)
+        return loss
+
+
+def random_dataset(n=256, dim=16, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, dim).astype(np.float32)
+    w = rs.randn(dim).astype(np.float32)
+    y = x @ w + 0.1 * rs.randn(n).astype(np.float32)
+    return x, y
+
+
+_X, _Y = random_dataset(4096, 16, seed=42)
+
+
+def batch_of(n, dim=16, seed=0):
+    """Slice a FIXED dataset (seed only moves the window, the task is
+    constant so loss can actually decrease across steps)."""
+    start = (seed * 61) % (len(_X) - n)
+    return {"x": _X[start:start + n], "y": _Y[start:start + n]}

@@ -1,0 +1,98 @@
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import OffloadDeviceEnum
+
+
+def test_batch_triangulation_micro_gas():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 4}, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triangulation_train_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 64,
+                           "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangulation_train_only():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({"train_batch_size": 10, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 4}, world_size=8)
+
+
+def test_missing_batch_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"optimizer": {"type": "Adam"}}, world_size=1)
+
+
+def test_auto_values_pass_through():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "gradient_clipping": "auto",
+                           "fp16": {"enabled": "auto"}}, world_size=1)
+    assert cfg.gradient_clipping == 0.0
+    assert not cfg.fp16.enabled
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps({"train_batch_size": 32, "bf16": {"enabled": True},
+                             "zero_optimization": {"stage": 2}}))
+    cfg = DeepSpeedConfig(str(p), world_size=4)
+    assert cfg.precision == "bf16"
+    assert cfg.zero_optimization_stage == 2
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_zero_legacy_cpu_offload_migration():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "cpu_offload": True}},
+                          world_size=1)
+    assert cfg.zero_config.offload_optimizer is not None
+    assert cfg.zero_config.offload_optimizer.device == OffloadDeviceEnum.cpu
+
+
+def test_zero_stage3_aliases():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 12345,
+        "stage3_max_live_parameters": 777}}, world_size=1)
+    assert cfg.zero_config.param_persistence_threshold == 12345
+    assert cfg.zero_config.max_live_parameters == 777
+    assert cfg.zero_config.overlap_comm is True  # stage-3 default
+
+
+def test_scheduler_and_optimizer_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=1)
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.params["warmup_num_steps"] == 10
+
+
+def test_parallel_block():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "parallel": {"model": 2, "pipe": 2}},
+                          world_size=2)
+    topo = cfg.parallel.topology()
+    assert topo.model == 2 and topo.pipe == 2

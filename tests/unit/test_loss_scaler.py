@@ -1,0 +1,53 @@
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.config import FP16Config
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    create_loss_scaler,
+    has_inf_or_nan,
+    tree_overflow,
+    update_scale,
+)
+
+
+def test_static_scale_never_changes():
+    s = create_loss_scaler(FP16Config(enabled=True, loss_scale=128.0))
+    assert s.static
+    s2 = update_scale(s, jnp.bool_(True))
+    assert float(s2.cur_scale) == 128.0
+
+
+def test_dynamic_halves_on_overflow_after_hysteresis():
+    cfg = FP16Config(enabled=True, initial_scale_power=4, hysteresis=2)
+    s = create_loss_scaler(cfg)
+    assert float(s.cur_scale) == 16.0
+    # first overflow: hysteresis spent, scale kept
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.cur_scale) == 16.0
+    # second overflow: halve
+    s = update_scale(s, jnp.bool_(True))
+    assert float(s.cur_scale) == 8.0
+
+
+def test_dynamic_grows_after_window():
+    cfg = FP16Config(enabled=True, initial_scale_power=4, loss_scale_window=4, hysteresis=1)
+    s = create_loss_scaler(cfg)
+    for _ in range(4):
+        s = update_scale(s, jnp.bool_(False))
+    assert float(s.cur_scale) == 32.0
+
+
+def test_min_scale_floor():
+    cfg = FP16Config(enabled=True, initial_scale_power=1, hysteresis=1, min_loss_scale=1.0)
+    s = create_loss_scaler(cfg)
+    for _ in range(10):
+        s = update_scale(s, jnp.bool_(True))
+    assert float(s.cur_scale) == 1.0
+
+
+def test_has_inf_or_nan():
+    assert bool(has_inf_or_nan(jnp.array([1.0, jnp.nan])))
+    assert bool(has_inf_or_nan(jnp.array([jnp.inf])))
+    assert not bool(has_inf_or_nan(jnp.array([1.0, -2.0])))
+    assert bool(tree_overflow({"a": jnp.ones(3), "b": jnp.array([jnp.nan])}))
+    assert not bool(tree_overflow({"a": jnp.ones(3)}))

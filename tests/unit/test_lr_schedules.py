@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupDecayLR,
+    WarmupLR,
+    get_lr_schedule,
+)
+
+
+def test_warmup_lr_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(5)), 0.5)
+    assert float(s(10)) == 1.0
+    assert float(s(100)) == 1.0  # holds
+
+
+def test_warmup_lr_log():
+    s = WarmupLR(warmup_max_lr=1.0, warmup_num_steps=100, warmup_type="log")
+    assert float(s(1)) == 0.0
+    np.testing.assert_allclose(float(s(100)), 1.0, rtol=1e-5)
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                      warmup_type="linear", total_num_steps=110)
+    np.testing.assert_allclose(float(s(10)), 1.0)
+    np.testing.assert_allclose(float(s(60)), 0.5)
+    np.testing.assert_allclose(float(s(110)), 0.0, atol=1e-6)
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10)
+    np.testing.assert_allclose(float(s(0)), 0.1)
+    np.testing.assert_allclose(float(s(10)), 1.0)
+    np.testing.assert_allclose(float(s(20)), 0.1, rtol=1e-5)
+    mom = s.get_mom(0)
+    np.testing.assert_allclose(float(mom), 0.99)
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    np.testing.assert_allclose(float(s(0)), 0.01)
+    np.testing.assert_allclose(float(s(10)), 0.02)
+
+
+def test_registry():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})
+    assert get_lr_schedule(None, {}) is None

@@ -1,0 +1,85 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import build_mesh
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition import (
+    partition_spec_for_param,
+    state_shardings,
+)
+
+
+def test_spec_stage3_shards_largest_dim():
+    mesh = build_mesh(data=8)
+    spec = partition_spec_for_param((128, 64), mesh, zero_shard=True)
+    assert spec == P(("data", "expert", "seq"))
+    spec = partition_spec_for_param((64, 128), mesh, zero_shard=True)
+    assert spec == P(None, ("data", "expert", "seq"))
+
+
+def test_spec_no_shard_when_indivisible():
+    mesh = build_mesh(data=8)
+    spec = partition_spec_for_param((7, 9), mesh, zero_shard=True)
+    assert spec == P()
+
+
+def test_spec_persistence_threshold():
+    mesh = build_mesh(data=8)
+    spec = partition_spec_for_param((16,), mesh, zero_shard=True, persistence_threshold=100)
+    assert spec == P()
+    spec = partition_spec_for_param((1024,), mesh, zero_shard=True, persistence_threshold=100)
+    assert spec == P(("data", "expert", "seq"))
+
+
+def test_spec_respects_tp_base():
+    mesh = build_mesh(data=4, model=2)
+    base = P(None, "model")
+    spec = partition_spec_for_param((256, 128), mesh, zero_shard=True, base_spec=base)
+    # model axis already used on dim1; zero axes land on dim0
+    assert spec == P(("data", "expert", "seq"), "model")
+
+
+def test_spec_no_zero_shard_keeps_base():
+    mesh = build_mesh(data=8)
+    spec = partition_spec_for_param((128, 64), mesh, zero_shard=False, base_spec=P("model"))
+    assert spec == P("model")
+
+
+def test_state_shardings_stages():
+    import optax
+
+    mesh = build_mesh(data=8)
+    params = {"w": jnp.zeros((64, 16)), "b": jnp.zeros((16,))}
+    shapes = jax.eval_shape(lambda: params)
+
+    # stage 1: params replicated, moments sharded
+    p_sh, shard_opt = state_shardings(shapes, mesh, DeepSpeedZeroConfig(stage=1))
+    assert p_sh["w"].spec == P()
+    tx = optax.adam(1e-3)
+    opt_shapes = jax.eval_shape(tx.init, shapes)
+    opt_sh = shard_opt(opt_shapes)
+    # ScaleByAdamState(count, mu, nu)
+    assert opt_sh[0].mu["w"].spec == P(("data", "expert", "seq"))
+    assert opt_sh[0].count.spec == P()
+
+    # stage 3: params sharded too (persistence threshold 0 so tiny test
+    # params do not stay replicated as "persistent")
+    p_sh, _ = state_shardings(
+        shapes, mesh, DeepSpeedZeroConfig(stage=3, stage3_param_persistence_threshold=0))
+    assert p_sh["w"].spec == P(("data", "expert", "seq"))
+    # b (16 elems) not divisible by 8? it is divisible -> sharded
+    assert p_sh["b"].spec == P(("data", "expert", "seq"))
+
+
+def test_state_shardings_stage0_all_replicated():
+    import optax
+
+    mesh = build_mesh(data=8)
+    params = {"w": jnp.zeros((64, 16))}
+    shapes = jax.eval_shape(lambda: params)
+    p_sh, shard_opt = state_shardings(shapes, mesh, DeepSpeedZeroConfig(stage=0))
+    assert p_sh["w"].spec == P()
+    opt_sh = shard_opt(jax.eval_shape(optax.adam(1e-3).init, shapes))
+    assert opt_sh[0].mu["w"].spec == P()
