@@ -1,0 +1,151 @@
+"""GPT-2 family decoder (learned positions, pre-LN, GELU).
+
+Matches the reference bring-up config "GPT-2 125M fine-tune" (BASELINE.json
+config #1). Same scan/remat machinery as Llama; partition rules follow the
+Megatron column/row layout the reference's GPT-2 inference policy slices
+(``module_inject/replace_policy.py`` HFGPT2LayerPolicy).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .layers import cross_entropy_loss, dot_product_attention, make_causal_mask, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    resid_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    embd_pdrop: float = 0.0
+    attention_impl: str = "xla"
+    scan_layers: bool = True
+    remat: bool = False
+
+    @staticmethod
+    def gpt2_125m(**over):
+        return GPT2Config(**{**dict(n_embd=768, n_layer=12, n_head=12), **over})
+
+    @staticmethod
+    def tiny(**over):
+        return GPT2Config(**{**dict(vocab_size=256, n_positions=128, n_embd=64,
+                                    n_layer=2, n_head=4), **over})
+
+
+class GPT2Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H, D = cfg.n_head, cfg.n_embd // cfg.n_head
+        qkv = nn.Dense(3 * C, name="c_attn", param_dtype=jnp.float32)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and not deterministic) else None
+        out = dot_product_attention(q, k, v, bias=mask, attention_impl=cfg.attention_impl,
+                                    dropout_rng=rng, dropout_rate=cfg.attn_pdrop,
+                                    deterministic=deterministic)
+        out = out.reshape(B, T, C)
+        out = nn.Dense(C, name="c_proj", param_dtype=jnp.float32)(out)
+        if cfg.resid_pdrop > 0 and not deterministic:
+            out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
+        return out
+
+
+class GPT2MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, name="c_fc", param_dtype=jnp.float32)(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, name="c_proj", param_dtype=jnp.float32)(h)
+        if cfg.resid_pdrop > 0 and not deterministic:
+            h = nn.Dropout(cfg.resid_pdrop)(h, deterministic=False)
+        return h
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        cfg = self.config
+        x = x + GPT2Attention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x), mask, deterministic)
+        x = x + GPT2MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(x), deterministic)
+        return x
+
+
+class _ScanBlock(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, mask, det = carry
+        x = GPT2Block(self.config, name="block")(x, mask, det)
+        return (x, mask, det), None
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte", param_dtype=jnp.float32)
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, name="wpe", param_dtype=jnp.float32)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = wte(input_ids) + wpe(positions)
+        mask = make_causal_mask(T, T, dtype=jnp.float32)[None, None, :, :]
+        if attention_mask is not None:
+            pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9)
+            mask = mask + pad.astype(mask.dtype)
+
+        if cfg.scan_layers:
+            block_cls = nn.remat(_ScanBlock, prevent_cse=False) if cfg.remat else _ScanBlock
+            scan = nn.scan(block_cls, variable_axes={"params": 0},
+                           split_rngs={"params": True, "dropout": True},
+                           length=cfg.n_layer)
+            (x, *_), _ = scan(cfg, name="h")((x, mask, deterministic), None)
+        else:
+            block_cls = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, name=f"h_{i}")(x, mask, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        # weight-tied LM head (GPT-2 convention)
+        logits = x @ wte.embedding.T.astype(x.dtype)
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, shift_labels(labels))
+
+    @staticmethod
+    def partition_rules(config: GPT2Config):
+        L = (None,) if config.scan_layers else ()
+        return [
+            (r"wte/embedding", P("model", None)),
+            (r"attn/c_attn/kernel", P(*L, None, "model")),
+            (r"attn/c_proj/kernel", P(*L, "model", None)),
+            (r"mlp/c_fc/kernel", P(*L, None, "model")),
+            (r"mlp/c_proj/kernel", P(*L, "model", None)),
+        ]

@@ -14,7 +14,7 @@ variant (EQuARX-style quantized psum in shard_map) can swap in for
 DCN-limited multi-slice topologies without changing this interface.
 """
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -82,14 +82,83 @@ def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
 def get_onebit_optimizer(kind: str, lr, freeze_step: int = 100000, betas=(0.9, 0.999),
                          eps: float = 1e-8, weight_decay: float = 0.0, mesh=None,
                          cuda_aware: bool = False, comm_backend_name: str = "xla",
+                         var_freeze_step: Optional[int] = None,
+                         var_update_scaler: int = 1,
                          **_) -> optax.GradientTransformation:
+    """Dispatch by kind:
+
+    - ``onebitadam``  — warmup then frozen-variance 1-bit momentum (adam.py:10)
+    - ``onebitlamb``  — same core + clamped trust-ratio scaling (lamb.py:11)
+    - ``zerooneadam`` — 0/1 Adam (zoadam.py:10): compression from the start,
+      variance refreshed on a ``var_update_scaler`` interval until
+      ``var_freeze_step``.
+    """
     b1, b2 = float(betas[0]), float(betas[1])
-    core = scale_by_onebit_adam(b1=b1, b2=b2, eps=eps, freeze_step=freeze_step)
+    if kind == "zerooneadam":
+        core = scale_by_zero_one_adam(b1=b1, b2=b2, eps=eps,
+                                      var_freeze_step=var_freeze_step or freeze_step,
+                                      var_update_scaler=var_update_scaler)
+    else:
+        core = scale_by_onebit_adam(b1=b1, b2=b2, eps=eps, freeze_step=freeze_step)
     chain = [core]
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay))
+    if kind == "onebitlamb":
+        from .optimizers import _scale_by_clamped_trust_ratio
+
+        chain.append(_scale_by_clamped_trust_ratio(0.01, 10.0))
     if callable(lr):
         chain.append(optax.scale_by_schedule(lambda step: -lr(step)))
     else:
         chain.append(optax.scale(-float(lr)))
     return optax.chain(*chain)
+
+
+def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                           var_freeze_step: int = 100000,
+                           var_update_scaler: int = 1) -> optax.GradientTransformation:
+    """0/1 Adam core (reference ``onebit/zoadam.py:10`` ``ZeroOneAdam``):
+    compression from step one, variance refreshed on a ``var_update_scaler``
+    interval and frozen after ``var_freeze_step``.
+
+    Stability adaptation (deliberate): the 1-bit quantization with error
+    feedback is applied to the *normalized update* m/(sqrt(v)+eps) rather
+    than the raw momentum. Quantizing raw momentum assigns every element the
+    tensor-mean magnitude, which explodes elements whose variance is near
+    zero; normalizing first bounds each element's step at ~1 (Adam's own
+    bound), making the no-warmup phase stable.
+    """
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return OneBitAdamState(count=jnp.zeros([], jnp.int32), mu=zeros(), nu=zeros(),
+                               error=zeros())
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        # bootstrap nu at step 1 (it starts at zero and compression runs from
+        # the first step — without this the first updates divide by ~eps)
+        update_var = (count <= var_freeze_step) & (
+            (count % var_update_scaler == 0) | (count == 1))
+
+        def leaf_update(g, mu, nu, err):
+            g = g.astype(jnp.float32)
+            new_mu = b1 * mu + (1 - b1) * g
+            new_nu = jnp.where(update_var, b2 * nu + (1 - b2) * g * g, nu)
+            normalized = new_mu / (jnp.sqrt(new_nu) + eps)
+            comp_in = normalized + err
+            scale = jnp.mean(jnp.abs(comp_in))
+            quantized = jnp.sign(comp_in) * scale
+            new_err = comp_in - quantized
+            return quantized, new_mu, new_nu, new_err
+
+        flat_u, tdef = jax.tree_util.tree_flatten(updates)
+        outs = [leaf_update(g, mu, nu, err) for g, mu, nu, err in zip(
+            flat_u, tdef.flatten_up_to(state.mu), tdef.flatten_up_to(state.nu),
+            tdef.flatten_up_to(state.error))]
+        return (tdef.unflatten([o[0] for o in outs]),
+                OneBitAdamState(count=count, mu=tdef.unflatten([o[1] for o in outs]),
+                                nu=tdef.unflatten([o[2] for o in outs]),
+                                error=tdef.unflatten([o[3] for o in outs])))
+
+    return optax.GradientTransformation(init_fn, update_fn)

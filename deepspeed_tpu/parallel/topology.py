@@ -82,6 +82,14 @@ class MeshTopology:
         """Reference semantics: world / (mp * pp) — includes expert & seq axes."""
         return self.data * self.expert * self.seq
 
+    @property
+    def batch_world_size(self) -> int:
+        """Number of distinct global-batch shards. Sequence-parallel group
+        members share the same samples (they split the sequence dim), so
+        ``seq`` is excluded here while it still counts toward the ZeRO
+        sharding world."""
+        return self.data * self.expert
+
 
 def build_mesh(topology: Optional[MeshTopology] = None,
                devices: Optional[Sequence] = None,

@@ -73,6 +73,17 @@ class DeepSpeedEngine:
         if dist_init_required is None or dist_init_required:
             dist.init_distributed()
 
+        # ---- config dict (load file path up front so "parallel" can size
+        # the mesh before the engine config is built) ----------------------
+        if isinstance(config, (str, os.PathLike)):
+            import json as _json
+
+            from .config_utils import dict_raise_error_on_duplicate_keys
+
+            with open(config) as _f:
+                config = _json.load(
+                    _f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+
         # ---- mesh -------------------------------------------------------
         if mesh is None:
             mesh = get_mesh()
@@ -82,7 +93,10 @@ class DeepSpeedEngine:
         self.mesh = mesh
         set_mesh(mesh)
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-        self.dp_world_size = shape.get("data", 1) * shape.get("expert", 1) * shape.get("seq", 1)
+        # batch sharding world: seq-parallel members share samples, so seq is
+        # excluded from batch-size accounting (but not from ZeRO sharding)
+        self.dp_world_size = shape.get("data", 1) * shape.get("expert", 1)
+        self.seq_world_size = shape.get("seq", 1)
         self.mp_world_size = shape.get("model", 1)
 
         # ---- config -----------------------------------------------------
@@ -197,12 +211,9 @@ class DeepSpeedEngine:
         return tx
 
     def _build_monitor(self):
-        try:
-            from ..monitor.monitor import MonitorMaster
+        from ..monitor.monitor import MonitorMaster
 
-            return MonitorMaster(self._config)
-        except Exception:
-            return None
+        return MonitorMaster(self._config)
 
     # ------------------------------------------------------------------
     # the compiled train step
@@ -361,31 +372,27 @@ class DeepSpeedEngine:
     # -- reference micro-step parity API --------------------------------
 
     def forward(self, batch: Dict[str, Any]):
-        """Parity: ``engine(batch)`` computes the microbatch loss.
+        """Parity: ``engine(batch)`` queues a global microbatch
+        (leading dim = micro_batch_size * dp) and returns a LAZY loss.
 
-        The actual fused computation happens at the GAS boundary in
-        ``step()``; forward here evaluates loss for the caller and queues the
-        microbatch (recompute-free accumulation happens in the compiled step).
+        The fused computation happens at the GAS boundary in ``step()``; the
+        returned loss only runs a (single) eval forward if the caller actually
+        forces its value (``float(loss)``), so the normal
+        forward/backward/step loop costs no extra FLOPs.
         """
         self._pending_microbatches.append(batch)
-        if self._eval_step is None:
-            self._eval_step = self._compile_eval_step()
-        mb = jax.device_put(batch, NamedSharding(self.mesh, PartitionSpec(BATCH_AXES)))
-        self._rng, rng = jax.random.split(self._rng)
-        loss = self._eval_step(self.state.params, mb, rng)
-        self._last_loss = loss
-        return loss
+        return _LazyLoss(self, batch)
 
     __call__ = None  # set below
 
     def backward(self, loss=None, **_):
         """Parity no-op: grads are computed inside the fused step (XLA AD).
         Reference: ``engine.backward`` :1750."""
-        self.micro_steps += 1
         return loss
 
     def step(self):
         """Parity: consume queued microbatches and take the optimizer step.
+        Each queued microbatch is a *global* microbatch (micro * dp samples).
         Reference: ``engine.step`` :1957."""
         if len(self._pending_microbatches) < self.gradient_accumulation_steps:
             return  # not at a GAS boundary yet (reference gates the same way)
@@ -497,6 +504,31 @@ class DeepSpeedEngine:
         return load_dir, client_state
 
 
+class _LazyLoss:
+    """Loss handle returned by the parity ``forward``: forcing it (float/
+    array) runs one eval forward; passing it straight to ``backward`` costs
+    nothing."""
+
+    def __init__(self, engine: DeepSpeedEngine, batch):
+        self._engine = engine
+        self._batch = batch
+        self._value = None
+
+    def _force(self):
+        if self._value is None:
+            self._value = self._engine.eval_batch(self._batch)
+        return self._value
+
+    def __float__(self):
+        return float(jax.device_get(self._force()))
+
+    def __jax_array__(self):
+        return jnp.asarray(self._force())
+
+    def __repr__(self):
+        return f"LazyLoss({float(self) if self._value is not None else 'unevaluated'})"
+
+
 DeepSpeedEngine.__call__ = DeepSpeedEngine.forward
 
 
@@ -526,7 +558,9 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     if training_data is not None:
         from .dataloader import DeepSpeedDataLoader
 
-        dataloader = DeepSpeedDataLoader(training_data,
-                                         batch_size=engine.micro_batch_size,
-                                         collate_fn=collate_fn)
+        # One SPMD process feeds the GLOBAL microbatch (micro * dp samples),
+        # unlike the reference where each rank loads micro samples.
+        dataloader = DeepSpeedDataLoader(
+            training_data, batch_size=engine.micro_batch_size * engine.dp_world_size,
+            collate_fn=collate_fn)
     return engine, engine, dataloader, engine.lr_scheduler

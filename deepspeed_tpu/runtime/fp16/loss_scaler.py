@@ -80,14 +80,18 @@ def update_scale(state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState
     new_hyst_overflow = jnp.where(hysteresis_spent, state.cur_hysteresis,
                                   state.cur_hysteresis - 1)
 
-    # clean path
+    # clean path: hysteresis is only restored when the window completes
+    # (reference semantics with consecutive_hysteresis=False — a clean step
+    # between two overflows must NOT refill the hysteresis budget, or
+    # intermittent overflows would never lower the scale)
     window_done = (state.cur_iter + 1) % state.scale_window == 0
     new_scale_clean = jnp.where(window_done, state.cur_scale * state.scale_factor,
                                 state.cur_scale)
+    new_hyst_clean = jnp.where(window_done, jnp.int32(state.hysteresis),
+                               state.cur_hysteresis)
 
     return state.replace(
         cur_scale=jnp.where(overflow, new_scale_overflow, new_scale_clean),
-        cur_hysteresis=jnp.where(overflow, new_hyst_overflow,
-                                 jnp.int32(state.hysteresis)),
+        cur_hysteresis=jnp.where(overflow, new_hyst_overflow, new_hyst_clean),
         cur_iter=jnp.where(overflow, jnp.int32(0), state.cur_iter + 1),
     )

@@ -45,6 +45,18 @@ def test_min_scale_floor():
     assert float(s.cur_scale) == 1.0
 
 
+def test_intermittent_overflow_still_halves():
+    """Clean steps between overflows must not refill hysteresis (reference
+    consecutive_hysteresis=False semantics)."""
+    cfg = FP16Config(enabled=True, initial_scale_power=4, hysteresis=2,
+                     loss_scale_window=1000)
+    s = create_loss_scaler(cfg)
+    for _ in range(3):  # overflow, clean, overflow -> second overflow halves
+        s = update_scale(s, jnp.bool_(True))
+        s = update_scale(s, jnp.bool_(False))
+    assert float(s.cur_scale) < 16.0
+
+
 def test_has_inf_or_nan():
     assert bool(has_inf_or_nan(jnp.array([1.0, jnp.nan])))
     assert bool(has_inf_or_nan(jnp.array([jnp.inf])))

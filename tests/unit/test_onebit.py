@@ -1,0 +1,69 @@
+"""1-bit optimizer tests (reference: ``tests/onebit/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.ops.onebit import scale_by_onebit_adam, scale_by_zero_one_adam
+from tests.unit.simple_model import SimpleModel, batch_of
+
+
+def test_onebit_adam_warmup_matches_adam_direction():
+    """During warmup the 1-bit core is plain Adam (reference warmup phase)."""
+    import optax
+
+    params = {"w": jnp.array([1.0, -2.0, 3.0])}
+    grads = {"w": jnp.array([0.1, -0.2, 0.3])}
+    ob = scale_by_onebit_adam(freeze_step=100)
+    ad = optax.scale_by_adam()
+    s_ob, s_ad = ob.init(params), ad.init(params)
+    u_ob, _ = ob.update(grads, s_ob, params)
+    u_ad, _ = ad.update(grads, s_ad, params)
+    np.testing.assert_allclose(np.asarray(u_ob["w"]), np.asarray(u_ad["w"]), rtol=1e-4)
+
+
+def test_onebit_compression_phase_signs():
+    """Past freeze_step updates use sign(momentum+error)*scale."""
+    params = {"w": jnp.ones(4)}
+    ob = scale_by_onebit_adam(freeze_step=1)
+    state = ob.init(params)
+    g = {"w": jnp.array([1.0, -1.0, 2.0, -2.0])}
+    u, state = ob.update(g, state, params)  # step1: warmup
+    u, state = ob.update(g, state, params)  # step2: compressed
+    vals = np.unique(np.round(np.abs(np.asarray(u["w"])), 6))
+    assert len(vals) <= 2  # magnitudes collapse to one scale per tensor
+
+
+def test_zero_one_adam_variance_interval():
+    params = {"w": jnp.ones(4)}
+    zo = scale_by_zero_one_adam(var_update_scaler=3, var_freeze_step=100)
+    state = zo.init(params)
+    g = {"w": jnp.ones(4)}
+    _, s1 = zo.update(g, state, params)
+    nu1 = float(np.asarray(s1.nu["w"])[0])
+    assert nu1 > 0.0  # step1 bootstraps the variance
+    _, s2 = zo.update(g, s1, params)
+    assert float(np.asarray(s2.nu["w"])[0]) == nu1  # step2: off-interval, frozen
+    _, s3 = zo.update(g, s2, params)
+    assert float(np.asarray(s3.nu["w"])[0]) > nu1  # step3: interval hit
+
+
+@pytest.mark.parametrize("opt,params", [
+    # freeze_step must leave enough warmup for the variance to establish
+    # (freezing after a handful of steps diverges — true of the reference
+    # algorithm as well, which freezes ~1/4 into training)
+    ("OneBitAdam", {"lr": 3e-3, "freeze_step": 8}),
+    ("OneBitLamb", {"lr": 3e-3, "freeze_step": 8}),
+    # 0/1 Adam compresses from step one; the variance freeze comes late in
+    # training (reference default 100k), so don't freeze inside the test
+    ("ZeroOneAdam", {"lr": 3e-3, "var_freeze_step": 1000}),
+])
+def test_engine_trains_with_onebit(opt, params):
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": opt, "params": params},
+           "steps_per_print": 0}
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg, example_batch=batch_of(2))
+    losses = [float(engine.train_batch(batch=batch_of(16))) for _ in range(15)]
+    assert losses[-1] < losses[0]
