@@ -28,7 +28,7 @@ def main():
     # ~400M-param Llama on one v5e chip, bf16 compute + fp32 master + Adam.
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                       num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-                      max_position_embeddings=1024, remat=True)
+                      max_position_embeddings=1024, remat=True, attention_impl="flash")
     model = LlamaForCausalLM(cfg)
     B, T = 8, 1024
     rs = np.random.RandomState(0)

@@ -56,7 +56,8 @@ class GPT2Attention(nn.Module):
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
         rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and not deterministic) else None
-        out = dot_product_attention(q, k, v, bias=mask, attention_impl=cfg.attention_impl,
+        out = dot_product_attention(q, k, v, bias=mask, causal=True,
+                                    attention_impl=cfg.attention_impl,
                                     dropout_rng=rng, dropout_rate=cfg.attn_pdrop,
                                     deterministic=deterministic)
         out = out.reshape(B, T, C)
@@ -116,10 +117,12 @@ class GPT2LMHeadModel(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = wte(input_ids) + wpe(positions)
-        mask = make_causal_mask(T, T, dtype=jnp.float32)[None, None, :, :]
+        # causality is applied inside the attention core (flash-compatible);
+        # the bias only carries the padding mask
+        mask = None
         if attention_mask is not None:
-            pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9)
-            mask = mask + pad.astype(mask.dtype)
+            mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+                jnp.float32)
 
         if cfg.scan_layers:
             block_cls = nn.remat(_ScanBlock, prevent_cse=False) if cfg.remat else _ScanBlock

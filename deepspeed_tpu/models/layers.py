@@ -67,30 +67,38 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.broadcast_to(x[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(b, t, h * n_rep, d)
 
 
-def dot_product_attention(q, k, v, bias=None, attention_impl: str = "xla",
-                          dropout_rng=None, dropout_rate: float = 0.0,
-                          deterministic: bool = True):
+def dot_product_attention(q, k, v, bias=None, causal: bool = False,
+                          attention_impl: str = "xla", dropout_rng=None,
+                          dropout_rate: float = 0.0, deterministic: bool = True):
     """[B, T, H, D] attention core.
 
     ``attention_impl='flash'`` routes to the Pallas flash-attention kernel
     (TPU); 'xla' is the einsum softmax reference (XLA fuses it well for
     moderate T). This mirrors the reference's split between fused CUDA
     softmax kernels and stock torch attention.
+
+    ``causal`` applies bottom-right-aligned causality; ``bias`` carries any
+    additive mask beyond that (e.g. padding). The flash kernel currently
+    supports causality but not an arbitrary bias or dropout — those cases
+    fall back to the XLA path so semantics never silently change.
     """
-    if attention_impl == "flash":
+    use_dropout = dropout_rate > 0.0 and not deterministic
+    if attention_impl == "flash" and bias is None and not use_dropout:
         from ..ops.pallas.flash_attention import flash_attention
 
-        causal = bias is None  # flash path handles causal internally
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=causal)
 
     depth = q.shape[-1]
     scale = 1.0 / np.sqrt(depth)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        logits = logits + make_causal_mask(q.shape[1], k.shape[1], dtype=jnp.float32,
+                                           offset=k.shape[1] - q.shape[1])[None, None]
     if bias is not None:
         logits = logits + bias
     logits = logits.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    if dropout_rate > 0.0 and not deterministic:
+    if use_dropout:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
         probs = probs * keep / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -77,7 +77,7 @@ class LlamaAttention(nn.Module):
         k = apply_rotary(k, cos, sin)
         k = repeat_kv(k, H // Hkv)
         v = repeat_kv(v, H // Hkv)
-        out = dot_product_attention(q, k, v, bias=mask,
+        out = dot_product_attention(q, k, v, bias=mask, causal=True,
                                     attention_impl=cfg.attention_impl)
         out = out.reshape(B, T, H * D)
         return dense(cfg.hidden_size, "o_proj")(out)
@@ -134,10 +134,12 @@ class LlamaModel(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, dtype=x.dtype)
-        mask = make_causal_mask(T, T, dtype=jnp.float32)[None, None, :, :]
+        # causality is applied inside the attention core (flash-compatible);
+        # the bias only carries the padding mask
+        mask = None
         if attention_mask is not None:
-            pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9)
-            mask = mask + pad.astype(mask.dtype)
+            mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+                jnp.float32)
 
         if cfg.scan_layers:
             block_cls = _ScanBlock
