@@ -1,0 +1,9 @@
+from .experts import ExpertMLP, Experts
+from .layer import MoE
+from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+from .utils import (is_moe_param, moe_partition_rules,
+                    split_params_into_moe_groups)
+
+__all__ = ["MoE", "MOELayer", "TopKGate", "Experts", "ExpertMLP",
+           "top1gating", "top2gating", "is_moe_param", "moe_partition_rules",
+           "split_params_into_moe_groups"]

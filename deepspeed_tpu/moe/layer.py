@@ -1,0 +1,72 @@
+"""User-facing MoE layer.
+
+Counterpart of ``deepspeed/moe/layer.py:15`` (``MoE``). Differences by
+design: no process-group creation (``_create_process_groups`` :90) — the
+``expert`` mesh axis already exists in the global ``Mesh`` and XLA routes the
+all_to_all; ``ep_size`` is therefore implied by the mesh, and
+``num_experts`` only needs to be divisible by the mesh's expert axis size.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .experts import Experts
+from .sharded_moe import MOELayer, TopKGate
+
+
+class MoE(nn.Module):
+    """Mixture-of-experts layer: returns ``(output, l_aux, exp_counts)``.
+
+    Args mirror the reference (``layer.py:16-49``): ``expert`` is a template
+    flax module; ``use_residual`` enables Residual-MoE (arXiv:2201.05596)
+    with a learned 2-way coefficient blend.
+    """
+
+    hidden_size: int
+    expert: nn.Module
+    num_experts: int = 1
+    ep_size: int = 1  # kept for API parity; actual EP degree comes from the mesh
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    enable_expert_tensor_parallelism: bool = False
+
+    def setup(self):
+        assert self.noisy_gate_policy is None or self.noisy_gate_policy in (
+            "None", "Jitter", "RSample"), \
+            f"Unsupported noisy_gate_policy: {self.noisy_gate_policy}"
+        log_dist(f"Creating MoE layer with num_experts: {self.num_experts} "
+                 f"| k: {self.k}", ranks=[0])
+        self.deepspeed_moe = MOELayer(
+            gate=TopKGate(
+                model_dim=self.hidden_size, num_experts=self.num_experts,
+                k=self.k, capacity_factor=self.capacity_factor,
+                eval_capacity_factor=self.eval_capacity_factor,
+                min_capacity=self.min_capacity,
+                noisy_gate_policy=self.noisy_gate_policy,
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts),
+            experts=Experts(expert=self.expert, num_experts=self.num_experts,
+                            name="experts"),
+        )
+        if self.use_residual:
+            self.mlp = self.expert.clone(name="residual_mlp")
+            self.coefficient = nn.Dense(2, name="coefficient")
+
+    def __call__(self, hidden_states, used_token=None, deterministic: bool = False):
+        output, l_aux, exp_counts = self.deepspeed_moe(
+            hidden_states, used_token, deterministic)
+        if self.use_residual:
+            mlp_out = self.mlp(hidden_states)
+            if isinstance(mlp_out, tuple):
+                mlp_out = mlp_out[0]
+            coef = nn.softmax(self.coefficient(hidden_states), axis=-1)
+            output = output * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+        return output, l_aux, exp_counts

@@ -1,0 +1,268 @@
+"""Top-k gated mixture-of-experts, TPU-native.
+
+Counterpart of ``deepspeed/moe/sharded_moe.py`` (``top1gating`` :177,
+``top2gating`` :278, ``TopKGate`` :351, ``MOELayer`` :439). The gating math is
+kept at parity (softmax gates, capacity buffers, load-balancing aux loss,
+random token selection, Gumbel top-2). The *mechanism* differs by design:
+
+- DeepSpeed dispatches per-rank tokens with an explicit autograd
+  ``_AllToAll`` (:89) over the expert process group. Here dispatch/combine
+  are einsums over a globally-sharded token axis, and a
+  ``with_sharding_constraint`` pins the dispatched ``[E, C, M]`` tensor to the
+  ``expert`` mesh axis — the XLA SPMD partitioner inserts the all_to_all
+  (and its transpose for the backward) on ICI.
+- Capacity is **static**: shapes under ``jit`` are compile-time constants, so
+  ``drop_tokens=False`` maps to ``capacity = num_tokens`` (nothing can drop)
+  rather than a dynamically-allreduced max (:216-219).
+- Gating runs over the *global* token set instead of per-rank locals; total
+  capacity matches the reference (`S/E * cf` summed over ranks) while
+  removing per-rank quantization of the capacity buffer.
+"""
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.topology import EXPERT_AXIS
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Static capacity per expert (reference ``_capacity``: ceil(S/E * cf))."""
+    capacity = int(math.ceil((num_tokens / num_experts) * capacity_factor))
+    return max(capacity, min_capacity)
+
+
+def multiplicative_jitter(x, rng, epsilon: float = 1e-2):
+    """Multiply by U(1-eps, 1+eps) — reference ``multiplicative_jitter`` :46."""
+    if epsilon == 0:
+        return x
+    noise = jax.random.uniform(rng, x.shape, x.dtype, 1.0 - epsilon, 1.0 + epsilon)
+    return x * noise
+
+
+def gumbel_rsample(rng, shape):
+    return jax.random.gumbel(rng, shape, jnp.float32)
+
+
+def _keep_top_tokens(mask: jnp.ndarray, priority: jnp.ndarray, capacity: int):
+    """Keep at most ``capacity`` tokens per expert, highest ``priority`` first.
+
+    Reference: ``_top_idx`` + scatter (``sharded_moe.py:236-240``). ``mask``
+    and ``priority`` are [S, E]; returns the filtered mask.
+    """
+    s = mask.shape[0]
+    if capacity >= s:
+        return mask
+    top_idx = jax.lax.top_k(priority.T, capacity)[1]          # [E, capacity]
+    keep = jax.nn.one_hot(top_idx, s, dtype=mask.dtype).sum(axis=1).T  # [S, E]
+    return mask * keep
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float,
+               min_capacity: int,
+               used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[jax.Array] = None):
+    """Top-1 gating (reference ``top1gating`` :177). All math in fp32.
+
+    Returns ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C],
+    exp_counts [E])``.
+    """
+    logits = logits.astype(jnp.float32)
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+
+    capacity = (_capacity(s, e, capacity_factor, min_capacity)
+                if drop_tokens else s)
+
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample noisy gating needs an rng")
+        rng, noise_rng = jax.random.split(rng)
+        select_logits = logits + gumbel_rsample(noise_rng, logits.shape)
+    else:
+        select_logits = gates
+    indices1 = jnp.argmax(select_logits, axis=1)
+    mask1 = jax.nn.one_hot(indices1, e, dtype=jnp.float32)
+
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None].astype(jnp.float32)
+
+    exp_counts = jax.lax.stop_gradient(mask1.sum(axis=0)).astype(jnp.int32)
+
+    # load-balancing loss: E * sum(mean gate prob * dispatch fraction)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    # Random Token Selection: priority = mask * U(0,1); without RTS the
+    # priority is the mask itself (top_k keeps lowest token indices first).
+    if use_rts:
+        if rng is None:
+            raise ValueError("Random Token Selection needs an rng")
+        rng, rts_rng = jax.random.split(rng)
+        priority = mask1 * jax.random.uniform(rts_rng, mask1.shape)
+    else:
+        priority = mask1
+    mask1 = _keep_top_tokens(mask1, priority, capacity)
+
+    # position of each surviving token inside its expert's capacity buffer
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+
+    gates = gates * mask1
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
+    combine_weights = jnp.einsum("se,sc->sec", gates, locations1_sc)
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float,
+               min_capacity: int,
+               rng: Optional[jax.Array] = None):
+    """Top-2 gating (reference ``top2gating`` :278): second expert chosen by
+    the Gumbel-max trick over the non-top-1 logits; gate probabilities of the
+    two winners renormalized."""
+    logits = logits.astype(jnp.float32)
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+    capacity = _capacity(s, e, capacity_factor * 2.0, min_capacity)
+
+    indices1 = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1, e, dtype=jnp.float32)
+
+    if rng is None:
+        raise ValueError("top-2 gating needs an rng (Gumbel sampling)")
+    logits_w_noise = logits + gumbel_rsample(rng, logits.shape)
+    logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits_w_noise)
+    indices2 = jnp.argmax(logits_except1, axis=1)
+    mask2 = jax.nn.one_hot(indices2, e, dtype=jnp.float32)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    locations2 = jnp.cumsum(mask2, axis=0) - mask2
+    locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jax.lax.stop_gradient(mask1.sum(axis=0)).astype(jnp.int32)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.mean(me * ce) * e * e
+
+    mask1 = mask1 * (locations1 < capacity)
+    mask2 = mask2 * (locations2 < capacity)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1).astype(jnp.int32)
+
+    gates1_s = jnp.einsum("se,se->s", gates, mask1)
+    gates2_s = jnp.einsum("se,se->s", gates, mask2)
+    denom = jnp.clip(gates1_s + gates2_s, min=jnp.finfo(jnp.float32).eps)
+    gates1 = (gates1_s / denom)[:, None] * mask1
+    gates2 = (gates2_s / denom)[:, None] * mask2
+
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
+    locations2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=jnp.float32)
+    combine_weights = (jnp.einsum("se,sc->sec", gates1, locations1_sc)
+                       + jnp.einsum("se,sc->sec", gates2, locations2_sc))
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate module (reference ``TopKGate`` :351). fp32 throughout; the gate
+    projection has no bias. Noise comes from the flax ``gating`` rng
+    collection — pass ``rngs={'gating': key}`` at apply time when training."""
+
+    model_dim: int
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    def setup(self):
+        if self.k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gatings are supported.")
+        self.wg = nn.Dense(self.num_experts, use_bias=False,
+                           param_dtype=jnp.float32, dtype=jnp.float32, name="wg")
+
+    def _gating_rng(self):
+        return self.make_rng("gating") if self.has_rng("gating") else None
+
+    def __call__(self, x, used_token=None, deterministic: bool = False):
+        x = x.astype(jnp.float32)
+        rng = None if deterministic else self._gating_rng()
+        if self.noisy_gate_policy == "Jitter" and not deterministic and rng is not None:
+            rng, jitter_rng = jax.random.split(rng)
+            x = multiplicative_jitter(x, jitter_rng)
+        logits = self.wg(x)
+        cf = self.eval_capacity_factor if deterministic else self.capacity_factor
+        if self.k == 1:
+            return top1gating(
+                logits, cf, self.min_capacity, used_token,
+                None if deterministic else self.noisy_gate_policy,
+                self.drop_tokens, self.use_rts and not deterministic, rng)
+        return top2gating(logits, cf, self.min_capacity,
+                          rng if rng is not None else jax.random.PRNGKey(0))
+
+
+class MOELayer(nn.Module):
+    """GShard MoE layer (reference ``MOELayer`` :439).
+
+    ``experts`` is an ``Experts`` module applying a stacked expert bank to
+    ``[E, C, M]``. Dispatch: ``einsum('sec,sm->ecm')`` then a sharding
+    constraint pinning dim 0 to the ``expert`` axis — the compiler's
+    all_to_all replaces the reference's explicit ``_AllToAll`` autograd op.
+    Returns ``(output, l_aux, exp_counts)``.
+    """
+
+    gate: TopKGate
+    experts: nn.Module
+
+    @nn.compact
+    def __call__(self, x, used_token=None, deterministic: bool = False):
+        orig_shape = x.shape
+        d_model = x.shape[-1]
+        tokens = x.reshape(-1, d_model)
+
+        l_aux, combine_weights, dispatch_mask, exp_counts = self.gate(
+            tokens, used_token, deterministic)
+
+        dispatched = jnp.einsum("sec,sm->ecm",
+                                dispatch_mask.astype(x.dtype), tokens)
+        # [E, C, M] expert-sharded on dim 0 → XLA all_to_all from the
+        # token-sharded layout (reference: falltoall, sharded_moe.py:491)
+        dispatched = _expert_shard(dispatched)
+
+        expert_output = self.experts(dispatched)
+        expert_output = _expert_shard(expert_output)
+
+        combined = jnp.einsum("sec,ecm->sm",
+                              combine_weights.astype(x.dtype), expert_output)
+        return combined.reshape(orig_shape), l_aux, exp_counts
+
+
+def _expert_shard(x):
+    """Pin dim 0 (experts) to the expert mesh axis if a mesh is active."""
+    from jax.sharding import PartitionSpec
+
+    from ..parallel.topology import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None or EXPERT_AXIS not in mesh.axis_names:
+        return x
+    if dict(zip(mesh.axis_names, mesh.devices.shape)).get(EXPERT_AXIS, 1) == 1:
+        return x
+    spec = PartitionSpec(EXPERT_AXIS, *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))

@@ -1,0 +1,86 @@
+"""MoE parameter bookkeeping.
+
+Counterpart of ``deepspeed/moe/utils.py`` (``is_moe_param`` :10,
+``split_params_into_different_moe_groups_for_optimizer`` :61) and
+``deepspeed/moe/mappings.py`` (``drop_tokens``/``gather_tokens`` :27,:50).
+
+The reference tags tensors with ``param.allreduce = False`` so the DP
+allreduce skips expert params and a separate expert-data-parallel group
+reduces them. Under SPMD none of that bookkeeping exists: expert params are
+*stacked* ``[E, ...]`` arrays sharded over the ``expert`` mesh axis, so XLA
+already reduces their grads over exactly the expert-data-parallel subset.
+What remains useful is (a) identifying expert params by path for weight
+decay / LR groups and checkpoint layout, (b) the partition rules that pin
+the stacked dim to the expert axis.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+from ..parallel.topology import EXPERT_AXIS
+
+#: flax param path fragment marking expert-bank params (Experts module name).
+MOE_PATH_PATTERN = r"(^|/)experts(/|$)"
+
+
+def is_moe_param(path: str) -> bool:
+    """Path-based analog of ``is_moe_param`` (``moe/utils.py:10``)."""
+    return re.search(MOE_PATH_PATTERN, path) is not None
+
+
+def moe_partition_rules() -> List[Tuple[str, PartitionSpec]]:
+    """Partition rules pinning stacked expert params' dim 0 to ``expert``.
+
+    Compose these ahead of a model's TP rules when passing
+    ``partition_rules`` to ``initialize`` — first match wins.
+    """
+    return [(MOE_PATH_PATTERN + r".*", PartitionSpec(EXPERT_AXIS))]
+
+
+def split_params_into_moe_groups(params: Any) -> Dict[str, Any]:
+    """Label tree: ``'moe'`` for expert params, ``'dense'`` otherwise.
+
+    Counterpart of ``split_params_into_different_moe_groups_for_optimizer``
+    (``moe/utils.py:61``): feed to ``optax.multi_transform`` to give expert
+    params their own optimizer/weight-decay settings.
+    """
+
+    def label(path, _leaf):
+        path_s = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        return "moe" if is_moe_param(path_s) else "dense"
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def drop_tokens(x, dim: int = 0):
+    """Parity shim for ``mappings.py:27``: under TP the reference scatters
+    tokens so each tensor-parallel rank keeps a distinct slice. SPMD analog:
+    a sharding constraint placing ``dim`` on the ``model`` axis."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.topology import MODEL_AXIS, get_mesh
+
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = MODEL_AXIS
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def gather_tokens(x, dim: int = 0):
+    """Parity shim for ``mappings.py:50``: re-replicate a token-sliced tensor
+    across the ``model`` axis (the inverse of :func:`drop_tokens`)."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.topology import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*([None] * x.ndim))))

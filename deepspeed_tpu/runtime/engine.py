@@ -177,9 +177,18 @@ class DeepSpeedEngine:
     # construction helpers
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _make_rngs(base):
+        """Per-apply rng collections: dropout + MoE gating noise (reference:
+        cuda rng tracker / gumbel sampling in sharded_moe.py)."""
+        if base is None:
+            return None
+        return {"dropout": base, "gating": jax.random.fold_in(base, 1)}
+
     def _init_params(self, example_batch):
         self._rng, init_rng = jax.random.split(self._rng)
-        variables = self.module.init(init_rng, **example_batch)
+        rngs = {"params": init_rng, **self._make_rngs(jax.random.fold_in(init_rng, 7))}
+        variables = self.module.init(rngs, **example_batch)
         return variables["params"] if "params" in variables else variables
 
     def _build_lr_scheduler(self):
@@ -222,7 +231,7 @@ class DeepSpeedEngine:
     def _default_loss(self, params, batch, rng):
         """Default loss: model returns scalar loss (HF-style) or (loss, aux)."""
         out = self.module.apply({"params": params}, **batch,
-                                rngs={"dropout": rng} if rng is not None else None)
+                                rngs=self._make_rngs(rng))
         if isinstance(out, tuple):
             return out[0], out[1:]
         if isinstance(out, dict) and "loss" in out:
@@ -421,8 +430,10 @@ class DeepSpeedEngine:
         if self._eval_step is None:
             self._eval_step = self._compile_eval_step()
         mb = jax.device_put(batch, NamedSharding(self.mesh, PartitionSpec(BATCH_AXES)))
-        self._rng, rng = jax.random.split(self._rng)
-        return self._eval_step(self.state.params, mb, rng)
+        # fixed rng: eval losses are reproducible call-to-call (stochastic
+        # layers like MoE gating see the same noise for the same batch)
+        return self._eval_step(self.state.params, mb,
+                               jax.random.PRNGKey(self._config.seed))
 
     # ------------------------------------------------------------------
     # introspection (reference config accessor properties engine.py:466-788)

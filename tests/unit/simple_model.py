@@ -21,6 +21,32 @@ class SimpleModel(nn.Module):
         return loss
 
 
+class SimpleMoEModel(nn.Module):
+    """Counterpart of the reference ``SimpleMoEModel`` (:42): linear → MoE →
+    linear → MSE loss + gate aux loss."""
+
+    hidden_dim: int = 16
+    num_experts: int = 4
+    k: int = 1
+    use_residual: bool = False
+
+    @nn.compact
+    def __call__(self, x, y):
+        from deepspeed_tpu.moe import ExpertMLP, MoE
+
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        expert = ExpertMLP(hidden_size=self.hidden_dim,
+                           intermediate_size=self.hidden_dim * 2)
+        h, l_aux, _counts = MoE(hidden_size=self.hidden_dim, expert=expert,
+                                num_experts=self.num_experts, k=self.k,
+                                capacity_factor=2.0, min_capacity=1,
+                                use_residual=self.use_residual)(h)
+        out = nn.Dense(1)(h)
+        loss = jnp.mean((out.squeeze(-1) - y) ** 2)
+        return loss + 0.01 * l_aux
+
+
 def random_dataset(n=256, dim=16, seed=0):
     rs = np.random.RandomState(seed)
     x = rs.randn(n, dim).astype(np.float32)

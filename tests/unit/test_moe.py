@@ -1,0 +1,180 @@
+"""MoE tests (counterpart of reference ``tests/unit/test_moe.py`` and the
+gating math in ``sharded_moe.py``)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.moe import (ExpertMLP, Experts, MoE, MOELayer, TopKGate,
+                               is_moe_param, moe_partition_rules,
+                               split_params_into_moe_groups, top1gating,
+                               top2gating)
+from deepspeed_tpu.parallel import build_mesh, set_mesh
+from tests.unit.simple_model import SimpleMoEModel, batch_of
+
+
+# ---------------------------------------------------------------------------
+# gating math
+# ---------------------------------------------------------------------------
+
+def _logits(s=32, e=4, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(s, e).astype(np.float32))
+
+
+def test_top1_dispatch_consistency():
+    logits = _logits()
+    l_aux, combine, dispatch, counts = top1gating(
+        logits, capacity_factor=2.0, min_capacity=1, use_rts=False)
+    s, e = logits.shape
+    # each token goes to at most one (expert, slot)
+    assert dispatch.sum(axis=(1, 2)).max() <= 1
+    # combine weights are the gate softmax prob where dispatched
+    gates = jax.nn.softmax(logits, axis=1)
+    tok_w = combine.sum(axis=(1, 2))
+    chosen = gates.max(axis=1)
+    dispatched_mask = dispatch.sum(axis=(1, 2)) > 0
+    np.testing.assert_allclose(np.where(dispatched_mask, chosen, 0.0), tok_w, rtol=1e-6)
+    # capacity respected: <= ceil(s/e * cf) tokens per expert
+    assert counts.sum() <= s
+    assert dispatch.sum(axis=(0, 2)).max() <= int(np.ceil(s / e * 2.0))
+    assert np.isfinite(float(l_aux)) and float(l_aux) > 0
+
+
+def test_top1_capacity_drops_tokens():
+    # all tokens prefer expert 0 → only `capacity` survive
+    logits = jnp.tile(jnp.asarray([[10.0, 0.0, 0.0, 0.0]]), (16, 1))
+    _, combine, dispatch, _ = top1gating(
+        logits, capacity_factor=1.0, min_capacity=1, use_rts=False)
+    capacity = int(np.ceil(16 / 4 * 1.0))
+    assert int((dispatch.sum(axis=(1, 2)) > 0).sum()) == capacity
+    # without drop_tokens, capacity = S and nothing drops
+    _, _, dispatch_full, _ = top1gating(
+        logits, capacity_factor=1.0, min_capacity=1, use_rts=False,
+        drop_tokens=False)
+    assert int((dispatch_full.sum(axis=(1, 2)) > 0).sum()) == 16
+
+
+def test_top1_rts_needs_rng_and_is_deterministic_given_key():
+    logits = _logits()
+    with pytest.raises(ValueError):
+        top1gating(logits, 1.0, 1, use_rts=True)
+    out1 = top1gating(logits, 1.0, 1, use_rts=True, rng=jax.random.PRNGKey(7))
+    out2 = top1gating(logits, 1.0, 1, use_rts=True, rng=jax.random.PRNGKey(7))
+    np.testing.assert_allclose(out1[1], out2[1])
+
+
+def test_top2_combine_weights_normalized():
+    logits = _logits(s=64, e=4, seed=1)
+    l_aux, combine, dispatch, _ = top2gating(
+        logits, capacity_factor=4.0, min_capacity=1, rng=jax.random.PRNGKey(0))
+    # with ample capacity every token keeps both experts; weights sum to 1
+    tok_w = combine.sum(axis=(1, 2))
+    np.testing.assert_allclose(tok_w, np.ones_like(tok_w), rtol=1e-5)
+    assert int(dispatch.sum()) == 2 * 64
+    assert np.isfinite(float(l_aux))
+
+
+def test_used_token_masks_dispatch():
+    logits = _logits()
+    used = jnp.asarray([1.0] * 16 + [0.0] * 16)
+    _, _, dispatch, counts = top1gating(
+        logits, 4.0, 1, used_token=used, use_rts=False)
+    assert dispatch[16:].sum() == 0
+    assert counts.sum() <= 16
+
+
+# ---------------------------------------------------------------------------
+# modules
+# ---------------------------------------------------------------------------
+
+def test_moe_layer_forward_shapes():
+    layer = MoE(hidden_size=8,
+                expert=ExpertMLP(hidden_size=8, intermediate_size=16),
+                num_experts=4, k=1, capacity_factor=2.0, min_capacity=1)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 8).astype(np.float32))
+    params = layer.init({"params": jax.random.PRNGKey(0),
+                         "gating": jax.random.PRNGKey(1)}, x)
+    out, l_aux, counts = layer.apply(params, x,
+                                     rngs={"gating": jax.random.PRNGKey(2)})
+    assert out.shape == x.shape
+    assert counts.shape == (4,)
+    assert np.isfinite(float(l_aux))
+
+
+def test_moe_residual_forward():
+    layer = MoE(hidden_size=8,
+                expert=ExpertMLP(hidden_size=8, intermediate_size=16),
+                num_experts=2, use_residual=True, min_capacity=1,
+                capacity_factor=2.0)
+    x = jnp.ones((2, 4, 8), jnp.float32)
+    params = layer.init({"params": jax.random.PRNGKey(0),
+                         "gating": jax.random.PRNGKey(1)}, x)
+    out, _, _ = layer.apply(params, x, rngs={"gating": jax.random.PRNGKey(2)})
+    assert out.shape == x.shape
+
+
+def test_experts_are_independent():
+    """Each expert must apply its own weights (stacked, not shared)."""
+    experts = Experts(expert=ExpertMLP(hidden_size=4, intermediate_size=8),
+                      num_experts=3)
+    x = jnp.ones((3, 5, 4), jnp.float32)
+    params = experts.init(jax.random.PRNGKey(0), x)
+    out = experts.apply(params, x)
+    assert out.shape == (3, 5, 4)
+    # identical inputs per expert but distinct stacked weights → distinct outputs
+    assert not np.allclose(out[0], out[1])
+    # stacked params carry the expert dim
+    leaves = jax.tree_util.tree_leaves(params)
+    assert all(l.shape[0] == 3 for l in leaves)
+
+
+def test_moe_param_utils():
+    model = SimpleMoEModel(hidden_dim=16, num_experts=4)
+    b = batch_of(4)
+    params = model.init({"params": jax.random.PRNGKey(0),
+                         "gating": jax.random.PRNGKey(1)},
+                        jnp.asarray(b["x"]), jnp.asarray(b["y"]))["params"]
+    labels = split_params_into_moe_groups(params)
+    flat = jax.tree_util.tree_leaves_with_path(labels)
+    moe_labels = [v for p, v in flat if "experts" in str(p)]
+    dense_labels = [v for p, v in flat if "experts" not in str(p)]
+    assert moe_labels and all(l == "moe" for l in moe_labels)
+    assert dense_labels and all(l == "dense" for l in dense_labels)
+    assert is_moe_param("MoE_0/deepspeed_moe/experts/stacked/fc1/kernel")
+    assert not is_moe_param("Dense_0/kernel")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the expert-parallel mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_model_trains_on_expert_mesh(k):
+    """SimpleMoEModel trains under the engine with expert parallelism: the
+    4-expert bank is sharded over a 4-way expert mesh axis (all_to_all
+    inserted by XLA). Counterpart of reference test_moe.py engine tests."""
+    mesh = build_mesh(data=2, expert=4)
+    set_mesh(mesh)
+    model = SimpleMoEModel(hidden_dim=16, num_experts=4, k=k)
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_batch_size": 32,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0},
+        example_batch=batch_of(2),
+        partition_rules=moe_partition_rules(),
+        mesh=mesh)
+    # expert params actually sharded over the expert axis
+    expert_shardings = [
+        s for path, s in jax.tree_util.tree_leaves_with_path(engine.param_shardings)
+        if "stacked" in str(path)]
+    assert expert_shardings and all(
+        "expert" in str(s.spec) for s in expert_shardings), expert_shardings
+
+    losses = [float(engine.train_batch(batch=batch_of(32, seed=i)))
+              for i in range(15)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
