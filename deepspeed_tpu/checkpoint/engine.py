@@ -76,6 +76,16 @@ class AsyncCheckpointEngine(CheckpointEngine):
         return True
 
 
+def save_pytree(path: str, tree: Any) -> None:
+    """Save a bare pytree (e.g. inference params)."""
+    OrbaxCheckpointEngine().save(tree, path)
+
+
+def load_pytree(path: str, abstract_state: Any = None) -> Any:
+    """Load a bare pytree (e.g. inference params)."""
+    return OrbaxCheckpointEngine().load(path, abstract_state=abstract_state)
+
+
 # ---------------------------------------------------------------------------
 # TrainState save/load used by DeepSpeedEngine
 # ---------------------------------------------------------------------------

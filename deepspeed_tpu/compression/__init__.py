@@ -1,0 +1,2 @@
+from .quantization import (Quantizer, dequantize, dequantize_params, quantize,  # noqa: F401
+                           quantize_params)

@@ -1,0 +1,121 @@
+"""Grouped symmetric/asymmetric quantization.
+
+Counterpart of the reference quantizer kernels
+(``csrc/quantization/quantizer.cu``: ``ds_quantize_fp16``/``ds_sr_quantize``
+grouped sym/asym variants with stochastic rounding) and the compression
+quantizers (``deepspeed/compression/utils.py:56-184`` Sym/Asym). On TPU these
+are elementwise chains XLA fuses into surrounding ops; the stochastic-rounding
+variant draws from a passed-in rng (functional, reproducible) instead of
+cuRAND state.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _grouped(x: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, Tuple[int, ...]]:
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n % num_groups != 0:  # pad to a whole number of groups
+        pad = num_groups - n % num_groups
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(num_groups, -1), x.shape
+
+
+def quantize(x: jnp.ndarray, num_bits: int = 8, num_groups: int = 1,
+             symmetric: bool = True, stochastic_rng: Optional[jax.Array] = None):
+    """→ (q:int8/int32, scale, zero_point). Grouped over the flattened tensor
+    (reference groups the same way: one scale per contiguous group)."""
+    g, orig_shape = _grouped(x.astype(jnp.float32), num_groups)
+    qmax = 2 ** (num_bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = jnp.zeros_like(scale)
+    else:
+        lo = jnp.min(g, axis=1, keepdims=True)
+        hi = jnp.max(g, axis=1, keepdims=True)
+        scale = (hi - lo) / (2 ** num_bits - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = lo
+    y = (g - zero) / scale
+    if stochastic_rng is not None:  # stochastic rounding (ds_sr_quantize_*)
+        y = jnp.floor(y + jax.random.uniform(stochastic_rng, y.shape))
+    else:
+        y = jnp.rint(y)
+    lo_q = -qmax - 1 if symmetric else 0
+    hi_q = qmax if symmetric else 2 ** num_bits - 1
+    q = jnp.clip(y, lo_q, hi_q)
+    dtype = jnp.int8 if num_bits <= 8 else jnp.int32
+    return q.astype(dtype), scale, zero, orig_shape
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, zero: jnp.ndarray,
+               orig_shape: Tuple[int, ...], dtype=jnp.float32) -> jnp.ndarray:
+    flat = (q.astype(jnp.float32) * scale + zero).reshape(-1)
+    n = int(np.prod(orig_shape)) if orig_shape else 1
+    return flat[:n].reshape(orig_shape).astype(dtype)
+
+
+class Quantizer:
+    """Stateful convenience wrapper (reference ``ds_quantizer``
+    ``deepspeed/ops/quantizer/quantizer.py:12``)."""
+
+    def __init__(self, num_bits: int = 8, num_groups: int = 1, symmetric: bool = True):
+        self.num_bits = num_bits
+        self.num_groups = num_groups
+        self.symmetric = symmetric
+
+    def quantize(self, x, stochastic_rng=None):
+        return quantize(x, self.num_bits, self.num_groups, self.symmetric,
+                        stochastic_rng)
+
+    def dequantize(self, q, scale, zero, orig_shape, dtype=jnp.float32):
+        return dequantize(q, scale, zero, orig_shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Whole-pytree weight quantization (inference int8 path; reference
+# ``GroupQuantizer`` module_inject/replace_module.py:139)
+# ---------------------------------------------------------------------------
+
+_MIN_QUANT_SIZE = 4096  # small tensors (norms, biases) stay in fp
+
+
+def quantize_params(params: Any, num_groups: int = 32) -> Tuple[Any, Any]:
+    """int8-quantize every large floating leaf; returns (qparams, meta).
+    meta leaves are dicts {scale, zero, shape} or None (kept full-precision).
+    """
+    metas = {}
+
+    def q(path, leaf):
+        leaf = jnp.asarray(leaf)
+        key = jax.tree_util.keystr(path)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating) or leaf.size < _MIN_QUANT_SIZE:
+            metas[key] = None
+            return leaf
+        groups = min(num_groups, max(1, leaf.size // 128))
+        qv, scale, zero, shape = quantize(leaf, 8, groups, symmetric=True)
+        metas[key] = {"scale": scale, "zero": zero, "shape": shape}
+        return qv
+
+    qparams = jax.tree_util.tree_map_with_path(q, params)
+    return qparams, metas
+
+
+def dequantize_params(qparams: Any, metas: Dict, dtype=jnp.bfloat16) -> Any:
+    """Restore a quantized pytree at ``dtype``. Leaves that were kept in full
+    precision are also cast, so the restored tree is dtype-uniform (mixed
+    dtypes would break scan-carry invariants in scanned-layer models)."""
+    def dq(path, leaf):
+        meta = metas.get(jax.tree_util.keystr(path))
+        if meta is None:
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                return jnp.asarray(leaf, dtype)
+            return leaf
+        return dequantize(leaf, meta["scale"], meta["zero"], meta["shape"], dtype)
+
+    return jax.tree_util.tree_map_with_path(dq, qparams)

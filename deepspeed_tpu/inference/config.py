@@ -1,0 +1,60 @@
+"""Inference engine configuration.
+
+Counterpart of the reference ``deepspeed.init_inference`` keyword surface
+(``deepspeed/__init__.py:225`` and ``inference/engine.py:33``): ``mp_size``,
+``dtype``, ``replace_with_kernel_inject``, ``injection_policy``,
+``max_out_tokens``-style capacity knobs.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_dtype(dtype) -> Any:
+    if dtype is None:
+        return jnp.bfloat16
+    if isinstance(dtype, str):
+        return _DTYPES[dtype.lower()]
+    try:  # torch dtype passthrough (reference accepts torch.half etc.)
+        name = str(dtype).split(".")[-1]
+        if name in _DTYPES:
+            return _DTYPES[name]
+    except Exception:
+        pass
+    return dtype
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig:
+    """Reference: kw surface of ``deepspeed.init_inference``.
+
+    ``mp_size`` maps to the ``model`` mesh axis (tensor parallelism);
+    ``replace_with_kernel_inject`` keeps its meaning — convert an HF torch
+    model into our optimized decode graph via ``module_inject``.
+    """
+
+    mp_size: int = 1
+    dtype: Any = None
+    replace_with_kernel_inject: bool = True
+    injection_policy: Optional[Any] = None
+    checkpoint: Optional[str] = None
+    max_batch_size: int = 8
+    #: static KV-cache capacity (reference: ``max_out_tokens`` workspace size)
+    max_out_tokens: int = 1024
+    #: int8 weight quantization (reference quantization_setting / GroupQuantizer)
+    quantize: bool = False
+    quantize_groups: int = 32
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
+
+    def __post_init__(self):
+        self.dtype = resolve_dtype(self.dtype)

@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import cross_entropy_loss, dot_product_attention, make_causal_mask, shift_labels
+from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
+                     init_kv_cache, make_causal_mask, shift_labels, update_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,7 +47,7 @@ class GPT2Attention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, mask, deterministic=True):
+    def __call__(self, x, mask, layer_cache=None, cache_index=None, deterministic=True):
         cfg = self.config
         B, T, C = x.shape
         H, D = cfg.n_head, cfg.n_embd // cfg.n_head
@@ -55,16 +56,24 @@ class GPT2Attention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and not deterministic) else None
-        out = dot_product_attention(q, k, v, bias=mask, causal=True,
-                                    attention_impl=cfg.attention_impl,
-                                    dropout_rng=rng, dropout_rate=cfg.attn_pdrop,
-                                    deterministic=deterministic)
+        if layer_cache is not None:
+            layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
+            k = layer_cache["k"].astype(x.dtype)
+            v = layer_cache["v"].astype(x.dtype)
+            bias = cache_attention_bias(T, k.shape[1], cache_index, key_mask=mask)
+            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+        else:
+            rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and
+                                               not deterministic) else None
+            out = dot_product_attention(q, k, v, bias=mask, causal=True,
+                                        attention_impl=cfg.attention_impl,
+                                        dropout_rng=rng, dropout_rate=cfg.attn_pdrop,
+                                        deterministic=deterministic)
         out = out.reshape(B, T, C)
         out = nn.Dense(C, name="c_proj", param_dtype=jnp.float32)(out)
         if cfg.resid_pdrop > 0 and not deterministic:
             out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
-        return out
+        return out, layer_cache
 
 
 class GPT2MLP(nn.Module):
@@ -85,23 +94,26 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, mask, deterministic=True):
+    def __call__(self, x, mask, layer_cache=None, cache_index=None, deterministic=True):
         cfg = self.config
-        x = x + GPT2Attention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x), mask, deterministic)
+        attn, layer_cache = GPT2Attention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x), mask,
+            layer_cache, cache_index, deterministic)
+        x = x + attn
         x = x + GPT2MLP(cfg, name="mlp")(
             nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(x), deterministic)
-        return x
+        return x, layer_cache
 
 
 class _ScanBlock(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, mask, det = carry
-        x = GPT2Block(self.config, name="block")(x, mask, det)
-        return (x, mask, det), None
+    def __call__(self, carry, layer_cache):
+        x, mask, cache_index, det = carry
+        x, layer_cache = GPT2Block(self.config, name="block")(
+            x, mask, layer_cache, cache_index, det)
+        return (x, mask, cache_index, det), layer_cache
 
 
 class GPT2LMHeadModel(nn.Module):
@@ -109,38 +121,59 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, attention_mask=None,
-                 deterministic=True):
+                 deterministic=True, cache=None, cache_index=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte", param_dtype=jnp.float32)
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, name="wpe", param_dtype=jnp.float32)
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+            start = 0 if cache_index is None else cache_index
+            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
         x = wte(input_ids) + wpe(positions)
         # causality is applied inside the attention core (flash-compatible);
-        # the bias only carries the padding mask
+        # the bias only carries the padding mask (cached path: raw [B, S] mask)
         mask = None
         if attention_mask is not None:
-            mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
-                jnp.float32)
+            if cache is not None:
+                mask = attention_mask
+            else:
+                mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+                    jnp.float32)
 
         if cfg.scan_layers:
-            block_cls = nn.remat(_ScanBlock, prevent_cse=False) if cfg.remat else _ScanBlock
+            block_cls = nn.remat(_ScanBlock, prevent_cse=False) \
+                if (cfg.remat and cache is None) else _ScanBlock
             scan = nn.scan(block_cls, variable_axes={"params": 0},
                            split_rngs={"params": True, "dropout": True},
                            length=cfg.n_layer)
-            (x, *_), _ = scan(cfg, name="h")((x, mask, deterministic), None)
+            (x, *_), cache = scan(cfg, name="h")((x, mask, cache_index, deterministic), cache)
         else:
-            block_cls = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
+            block_cls = nn.remat(GPT2Block, prevent_cse=False) \
+                if (cfg.remat and cache is None) else GPT2Block
+            new_cache = [] if cache is not None else None
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, name=f"h_{i}")(x, mask, deterministic)
+                layer_cache = None if cache is None else \
+                    jax.tree_util.tree_map(lambda c: c[i], cache)
+                x, layer_cache = block_cls(cfg, name=f"h_{i}")(
+                    x, mask, layer_cache, cache_index, deterministic)
+                if new_cache is not None:
+                    new_cache.append(layer_cache)
+            if new_cache is not None:
+                cache = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_cache)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
         # weight-tied LM head (GPT-2 convention)
         logits = x @ wte.embedding.T.astype(x.dtype)
+        if cache is not None:
+            return logits, cache
         if labels is None:
             return logits
         return cross_entropy_loss(logits, shift_labels(labels))
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.config
+        return init_kv_cache(batch, max_len, cfg.n_head, cfg.n_embd // cfg.n_head,
+                             n_layers=cfg.n_layer, dtype=dtype)
 
     @staticmethod
     def partition_rules(config: GPT2Config):

@@ -104,6 +104,49 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def init_kv_cache(batch: int, max_len: int, num_kv_heads: int, head_dim: int,
+                  n_layers: Optional[int] = None, dtype=jnp.bfloat16):
+    """Allocate an empty KV cache.
+
+    Counterpart of the reference decode kernels' persistent KV workspace
+    (``csrc/transformer/inference/csrc/pt_binding.cpp`` ``softmax_context``
+    appends into a preallocated cache). Layout ``[L?, B, S, Hkv, D]`` — the
+    leading layer axis is present when the model scans its blocks, so the
+    cache threads through ``nn.scan`` as per-layer xs/ys.
+    """
+    shape = (batch, max_len, num_kv_heads, head_dim)
+    if n_layers is not None:
+        shape = (n_layers,) + shape
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def update_kv_cache(layer_cache, k, v, cache_index):
+    """Append ``[B, T, Hkv, D]`` keys/values at ``cache_index`` (traced ok)."""
+    idx = (0, cache_index, 0, 0)
+    return {
+        "k": jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype), idx),
+        "v": jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype), idx),
+    }
+
+
+def cache_attention_bias(q_len: int, cache_len: int, cache_index,
+                         key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Additive bias for attention over a partially-filled KV cache.
+
+    Query t sits at absolute position ``cache_index + t``; key j is visible iff
+    ``j <= cache_index + t`` (this covers both causal prefill and decode).
+    ``key_mask`` ``[B, S]`` (1 = real token) additionally hides padding.
+    Counterpart of the triangular masking in the reference's
+    ``softmax_context`` inference kernel.
+    """
+    q_pos = cache_index + jnp.arange(q_len)
+    kv_pos = jnp.arange(cache_len)
+    bias = jnp.where(q_pos[:, None] >= kv_pos[None, :], 0.0, -1e9)[None, None]
+    if key_mask is not None:
+        bias = bias + jnp.where(key_mask > 0, 0.0, -1e9)[:, None, None, :]
+    return bias.astype(jnp.float32)
+
+
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
                        ignore_index: int = -100) -> jnp.ndarray:
     """Token-mean cross entropy with ignore mask; stable in fp32."""

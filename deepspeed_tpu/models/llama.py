@@ -21,8 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import (RMSNorm, apply_rotary, cross_entropy_loss, dot_product_attention,
-                     make_causal_mask, repeat_kv, rotary_embedding, shift_labels)
+from .layers import (RMSNorm, apply_rotary, cache_attention_bias, cross_entropy_loss,
+                     dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
+                     rotary_embedding, shift_labels, update_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,7 +65,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, mask, deterministic=True):
+    def __call__(self, x, cos, sin, mask, layer_cache=None, cache_index=None,
+                 deterministic=True):
         cfg = self.config
         B, T, _ = x.shape
         H, Hkv, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -75,12 +77,21 @@ class LlamaAttention(nn.Module):
         v = dense(Hkv * D, "v_proj")(x).reshape(B, T, Hkv, D)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        k = repeat_kv(k, H // Hkv)
-        v = repeat_kv(v, H // Hkv)
-        out = dot_product_attention(q, k, v, bias=mask, causal=True,
-                                    attention_impl=cfg.attention_impl)
+        if layer_cache is not None:
+            # decode / cached-prefill path (reference: softmax_context KV-cache
+            # append, pt_binding.cpp). mask carries the [B, S] key-padding mask.
+            layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
+            k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
+            v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+            bias = cache_attention_bias(T, k.shape[1], cache_index, key_mask=mask)
+            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+        else:
+            k = repeat_kv(k, H // Hkv)
+            v = repeat_kv(v, H // Hkv)
+            out = dot_product_attention(q, k, v, bias=mask, causal=True,
+                                        attention_impl=cfg.attention_impl)
         out = out.reshape(B, T, H * D)
-        return dense(cfg.hidden_size, "o_proj")(out)
+        return dense(cfg.hidden_size, "o_proj")(out), layer_cache
 
 
 class LlamaMLP(nn.Module):
@@ -100,50 +111,62 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, mask, deterministic=True):
+    def __call__(self, x, cos, sin, mask, layer_cache=None, cache_index=None,
+                 deterministic=True):
         cfg = self.config
         h = RMSNorm(eps=cfg.rms_norm_eps, name="input_layernorm")(x)
-        x = x + LlamaAttention(cfg, name="self_attn")(h, cos, sin, mask, deterministic)
+        attn, layer_cache = LlamaAttention(cfg, name="self_attn")(
+            h, cos, sin, mask, layer_cache, cache_index, deterministic)
+        x = x + attn
         h = RMSNorm(eps=cfg.rms_norm_eps, name="post_attention_layernorm")(x)
         x = x + LlamaMLP(cfg, name="mlp")(h)
-        return x
+        return x, layer_cache
 
 
 class _ScanBlock(nn.Module):
     """Carry-through wrapper so nn.scan can thread (x) while broadcasting
-    (cos, sin, mask)."""
+    (cos, sin, mask); the per-layer KV cache rides the scan xs/ys."""
 
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, cos, sin, mask, det = carry
-        x = LlamaBlock(self.config, name="block")(x, cos, sin, mask, det)
-        return (x, cos, sin, mask, det), None
+    def __call__(self, carry, layer_cache):
+        x, cos, sin, mask, cache_index, det = carry
+        x, layer_cache = LlamaBlock(self.config, name="block")(
+            x, cos, sin, mask, layer_cache, cache_index, det)
+        return (x, cos, sin, mask, cache_index, det), layer_cache
 
 
 class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, attention_mask=None, deterministic=True):
+    def __call__(self, input_ids, positions=None, attention_mask=None, deterministic=True,
+                 cache=None, cache_index=None):
+        """``cache`` (from ``init_cache``) switches to the KV-cached decode
+        path: ``attention_mask`` is then a ``[B, cache_len]`` key-padding mask
+        and the return value is ``(hidden, new_cache)``."""
         cfg = self.config
         B, T = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
                      param_dtype=jnp.float32)(input_ids)
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+            start = 0 if cache_index is None else cache_index
+            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
         cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, dtype=x.dtype)
         # causality is applied inside the attention core (flash-compatible);
-        # the bias only carries the padding mask
+        # the bias only carries the padding mask (cached path: raw [B, S] mask)
         mask = None
         if attention_mask is not None:
-            mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
-                jnp.float32)
+            if cache is not None:
+                mask = attention_mask
+            else:
+                mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+                    jnp.float32)
 
         if cfg.scan_layers:
             block_cls = _ScanBlock
-            if cfg.remat:
+            if cfg.remat and cache is None:
                 block_cls = nn.remat(
                     _ScanBlock, static_argnums=(),
                     prevent_cse=False,
@@ -151,12 +174,23 @@ class LlamaModel(nn.Module):
             scan = nn.scan(block_cls, variable_axes={"params": 0},
                            split_rngs={"params": True, "dropout": True},
                            length=cfg.num_hidden_layers, metadata_params={})
-            (x, *_), _ = scan(cfg, name="layers")((x, cos, sin, mask, deterministic), None)
+            (x, *_), cache = scan(cfg, name="layers")(
+                (x, cos, sin, mask, cache_index, deterministic), cache)
         else:
-            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
+            block_cls = nn.remat(LlamaBlock, prevent_cse=False) \
+                if (cfg.remat and cache is None) else LlamaBlock
+            new_cache = [] if cache is not None else None
             for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin, mask, deterministic)
-        return RMSNorm(eps=cfg.rms_norm_eps, name="norm")(x)
+                layer_cache = None if cache is None else \
+                    jax.tree_util.tree_map(lambda c: c[i], cache)
+                x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                    x, cos, sin, mask, layer_cache, cache_index, deterministic)
+                if new_cache is not None:
+                    new_cache.append(layer_cache)
+            if new_cache is not None:
+                cache = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_cache)
+        x = RMSNorm(eps=cfg.rms_norm_eps, name="norm")(x)
+        return x if cache is None else (x, cache)
 
 
 class LlamaForCausalLM(nn.Module):
@@ -164,20 +198,30 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, attention_mask=None,
-                 deterministic=True):
+                 deterministic=True, cache=None, cache_index=None):
         cfg = self.config
         hidden = LlamaModel(cfg, name="model")(input_ids, positions, attention_mask,
-                                               deterministic)
+                                               deterministic, cache, cache_index)
+        if cache is not None:
+            hidden, cache = hidden
         if cfg.tie_word_embeddings:
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             logits = hidden @ embed.T.astype(hidden.dtype)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
                               param_dtype=jnp.float32)(hidden)
+        if cache is not None:
+            return logits, cache
         if labels is None:
             return logits
         shifted = shift_labels(labels)
         return cross_entropy_loss(logits, shifted)
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        """Empty KV cache for incremental decoding."""
+        cfg = self.config
+        return init_kv_cache(batch, max_len, cfg.num_key_value_heads, cfg.head_dim,
+                             n_layers=cfg.num_hidden_layers, dtype=dtype)
 
     @staticmethod
     def partition_rules(config: LlamaConfig):

@@ -1,0 +1,3 @@
+from .replace_module import replace_transformer_layer  # noqa: F401
+from .replace_policy import (HFGPT2LayerPolicy, HFLlamaLayerPolicy,  # noqa: F401
+                             generic_policies, match_policy)

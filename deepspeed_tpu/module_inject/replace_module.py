@@ -1,0 +1,45 @@
+"""Model replacement entry point.
+
+Counterpart of ``deepspeed/module_inject/replace_module.py:190``
+(``replace_transformer_layer``): walk an HF torch model, match a policy, and
+rebuild it as an optimized module. TPU-first difference: instead of swapping
+``nn.Module`` children in place for fused-CUDA replacements, we convert the
+WHOLE model into a flax decode graph once — XLA then fuses qkv+bias, softmax,
+residual+bias, gelu chains that the reference implements as ~30 hand-written
+inference kernels (``csrc/transformer/inference/csrc/pt_binding.cpp:1286``).
+"""
+
+from typing import Any, Optional, Tuple
+
+from ..utils.logging import log_dist
+from .replace_policy import DSPolicy, match_policy
+
+
+def replace_transformer_layer(model, policy: Optional[Any] = None,
+                              scan_layers: bool = True) -> Tuple[Any, Any]:
+    """Convert an HF torch model → ``(flax_module, params)``.
+
+    ``policy`` may be a ``DSPolicy`` instance/class or None for auto-detect
+    (reference ``replace_method='auto'``).
+    """
+    if policy is None:
+        policy = match_policy(model)
+        if policy is None:
+            raise ValueError(
+                f"No injection policy for {type(model).__name__}; known: "
+                "GPT2, Llama/Mistral. Pass policy= explicitly.")
+    elif isinstance(policy, type):
+        policy = policy()
+    if not isinstance(policy, DSPolicy):
+        raise TypeError(f"policy must be a DSPolicy, got {type(policy)}")
+    log_dist(f"module_inject: converting {type(model).__name__} via "
+             f"{type(policy).__name__}", ranks=[0])
+    return policy.convert(model, scan_layers=scan_layers)
+
+
+def revert_transformer_layer(*args, **kwargs):
+    """Reference ``replace_module.py:1001`` reverts injected modules. Our
+    conversion is out-of-place (the torch model is untouched), so there is
+    nothing to revert."""
+    raise NotImplementedError(
+        "conversion is out-of-place; the original HF model is unmodified")

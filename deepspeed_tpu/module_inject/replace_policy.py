@@ -1,0 +1,206 @@
+"""Injection policies: HF torch model families → TPU-native flax models.
+
+Counterpart of ``deepspeed/module_inject/replace_policy.py:66-435`` (policy
+classes for BERT/GPT2/GPT-Neo/OPT/BLOOM/...). A reference policy extracts
+per-layer torch tensors so fused CUDA modules can be rebuilt around them; our
+policy maps the full HF ``state_dict`` into the parameter pytree of the
+corresponding ``deepspeed_tpu.models`` module, stacking per-layer weights
+along a leading axis when the target model scans its blocks (the layout the
+ZeRO-3 gather-in-scan path requires).
+
+Tensor-parallel sharding needs no per-rank weight slicing here (reference
+``ReplaceWithTensorSlicing`` ``replace_module.py:18``): the converted params
+carry Megatron-layout partition rules and ``jax.device_put`` scatters each
+shard directly to its device.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach()
+        if hasattr(t, "to") and str(getattr(t, "dtype", "")) == "torch.bfloat16":
+            import torch
+
+            t = t.to(torch.float32)
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def _set(tree: Dict, path: str, value: np.ndarray) -> None:
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+
+
+class DSPolicy:
+    """Base policy. Subclasses declare the HF architecture they apply to and
+    produce ``(flax_module, params)``. Reference: ``DSPolicy``/
+    ``TransformerPolicy`` base in ``replace_policy.py``."""
+
+    #: HF class names this policy applies to (reference `_orig_layer_class`)
+    hf_model_types: Tuple[str, ...] = ()
+
+    @classmethod
+    def applies_to(cls, hf_model) -> bool:
+        name = type(hf_model).__name__
+        cfg_type = getattr(getattr(hf_model, "config", None), "model_type", None)
+        return name in cls.hf_model_types or cfg_type in cls.hf_model_types
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        raise NotImplementedError
+
+    @staticmethod
+    def partition_rules(config):
+        return None
+
+
+class HFGPT2LayerPolicy(DSPolicy):
+    """HF ``GPT2LMHeadModel`` → ``models.gpt2.GPT2LMHeadModel``.
+
+    Reference: ``HFGPT2LayerPolicy`` (``replace_policy.py``). HF GPT-2 uses
+    ``Conv1D`` ([in, out] kernels) so weights map to flax ``Dense`` kernels
+    with NO transpose; LayerNorm weight→scale.
+    """
+
+    hf_model_types = ("GPT2LMHeadModel", "gpt2", "GPT2Model")
+
+    LAYER_MAP = [  # (hf suffix, flax path under the block, transpose?)
+        ("ln_1.weight", "ln_1/scale", False),
+        ("ln_1.bias", "ln_1/bias", False),
+        ("attn.c_attn.weight", "attn/c_attn/kernel", False),
+        ("attn.c_attn.bias", "attn/c_attn/bias", False),
+        ("attn.c_proj.weight", "attn/c_proj/kernel", False),
+        ("attn.c_proj.bias", "attn/c_proj/bias", False),
+        ("ln_2.weight", "ln_2/scale", False),
+        ("ln_2.bias", "ln_2/bias", False),
+        ("mlp.c_fc.weight", "mlp/c_fc/kernel", False),
+        ("mlp.c_fc.bias", "mlp/c_fc/bias", False),
+        ("mlp.c_proj.weight", "mlp/c_proj/kernel", False),
+        ("mlp.c_proj.bias", "mlp/c_proj/bias", False),
+    ]
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        hc = hf_model.config
+        cfg = GPT2Config(vocab_size=hc.vocab_size, n_positions=hc.n_positions,
+                         n_embd=hc.n_embd, n_layer=hc.n_layer, n_head=hc.n_head,
+                         layer_norm_epsilon=hc.layer_norm_epsilon,
+                         scan_layers=scan_layers, remat=False)
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+        params: Dict[str, Any] = {}
+        _set(params, "wte/embedding", sd[f"{pfx}wte.weight"])
+        _set(params, "wpe/embedding", sd[f"{pfx}wpe.weight"])
+        _set(params, "ln_f/scale", sd[f"{pfx}ln_f.weight"])
+        _set(params, "ln_f/bias", sd[f"{pfx}ln_f.bias"])
+
+        def layer_leaf(i, suffix, transpose):
+            w = sd[f"{pfx}h.{i}.{suffix}"]
+            return w.T if transpose else w
+
+        if scan_layers:
+            for suffix, path, tr in self.LAYER_MAP:
+                stacked = np.stack([layer_leaf(i, suffix, tr)
+                                    for i in range(cfg.n_layer)])
+                _set(params, f"h/block/{path}", stacked)
+        else:
+            for i in range(cfg.n_layer):
+                for suffix, path, tr in self.LAYER_MAP:
+                    _set(params, f"h_{i}/{path}", layer_leaf(i, suffix, tr))
+        return GPT2LMHeadModel(cfg), params
+
+    @staticmethod
+    def partition_rules(config):
+        from ..models.gpt2 import GPT2LMHeadModel
+
+        return GPT2LMHeadModel.partition_rules(config)
+
+
+class HFLlamaLayerPolicy(DSPolicy):
+    """HF ``LlamaForCausalLM`` → ``models.llama.LlamaForCausalLM``.
+
+    HF Linear stores ``[out, in]`` → transpose to flax ``[in, out]`` kernels.
+    RoPE: both use the rotate-half convention, so no permutation is needed.
+    """
+
+    hf_model_types = ("LlamaForCausalLM", "llama", "LlamaModel", "MistralForCausalLM",
+                      "mistral")
+
+    LAYER_MAP = [
+        ("input_layernorm.weight", "input_layernorm/scale", False),
+        ("self_attn.q_proj.weight", "self_attn/q_proj/kernel", True),
+        ("self_attn.k_proj.weight", "self_attn/k_proj/kernel", True),
+        ("self_attn.v_proj.weight", "self_attn/v_proj/kernel", True),
+        ("self_attn.o_proj.weight", "self_attn/o_proj/kernel", True),
+        ("post_attention_layernorm.weight", "post_attention_layernorm/scale", False),
+        ("mlp.gate_proj.weight", "mlp/gate_proj/kernel", True),
+        ("mlp.up_proj.weight", "mlp/up_proj/kernel", True),
+        ("mlp.down_proj.weight", "mlp/down_proj/kernel", True),
+    ]
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+        hc = hf_model.config
+        cfg = LlamaConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            num_key_value_heads=getattr(hc, "num_key_value_heads",
+                                        hc.num_attention_heads),
+            max_position_embeddings=hc.max_position_embeddings,
+            rms_norm_eps=hc.rms_norm_eps,
+            rope_theta=getattr(hc, "rope_theta", 10000.0),
+            tie_word_embeddings=getattr(hc, "tie_word_embeddings", False),
+            scan_layers=scan_layers, remat=False)
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+
+        params: Dict[str, Any] = {}
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}embed_tokens.weight"])
+        _set(params, "model/norm/scale", sd[f"{pfx}norm.weight"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+
+        def layer_leaf(i, suffix, transpose):
+            w = sd[f"{pfx}layers.{i}.{suffix}"]
+            return w.T if transpose else w
+
+        if scan_layers:
+            for suffix, path, tr in self.LAYER_MAP:
+                stacked = np.stack([layer_leaf(i, suffix, tr)
+                                    for i in range(cfg.num_hidden_layers)])
+                _set(params, f"model/layers/block/{path}", stacked)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                for suffix, path, tr in self.LAYER_MAP:
+                    _set(params, f"model/layers_{i}/{path}", layer_leaf(i, suffix, tr))
+        return LlamaForCausalLM(cfg), params
+
+    @staticmethod
+    def partition_rules(config):
+        from ..models.llama import LlamaForCausalLM
+
+        return LlamaForCausalLM.partition_rules(config)
+
+
+#: All registered policies (reference: ``replace_policies`` list)
+generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy]
+
+
+def match_policy(hf_model) -> Optional[DSPolicy]:
+    """``replace_method='auto'`` policy discovery (reference
+    ``replace_module.py`` auto-matching over ``replace_policies``)."""
+    for policy_cls in generic_policies:
+        if policy_cls.applies_to(hf_model):
+            return policy_cls()
+    return None

@@ -150,7 +150,9 @@ def top2gating(logits: jnp.ndarray,
     locations2 = jnp.cumsum(mask2, axis=0) - mask2
     locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
 
-    exp_counts = jax.lax.stop_gradient(mask1.sum(axis=0)).astype(jnp.int32)
+    # per-expert load counts both first- and second-choice assignments
+    # (reference top2gating sums mask1 + mask2)
+    exp_counts = jax.lax.stop_gradient((mask1 + mask2).sum(axis=0)).astype(jnp.int32)
 
     me = jnp.mean(gates, axis=0)
     ce = jnp.mean(mask1, axis=0)
@@ -213,6 +215,12 @@ class TopKGate(nn.Module):
                 logits, cf, self.min_capacity, used_token,
                 None if deterministic else self.noisy_gate_policy,
                 self.drop_tokens, self.use_rts and not deterministic, rng)
+        if rng is None and not deterministic:
+            # same contract as top-1 use_rts: training-time stochastic gating
+            # must be seeded explicitly, never silently fixed
+            raise ValueError(
+                "top-2 gating needs rngs={'gating': key} at apply time "
+                "(or deterministic=True for eval)")
         return top2gating(logits, cf, self.min_capacity,
                           rng if rng is not None else jax.random.PRNGKey(0))
 
