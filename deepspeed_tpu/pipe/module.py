@@ -1,0 +1,266 @@
+"""Pipeline-parallel model container.
+
+Counterpart of ``deepspeed/runtime/pipe/module.py`` (``LayerSpec`` :23,
+``TiedLayerSpec`` :71, ``PipelineModule`` :85). The model is expressed as a
+list of layer specs; layers are partitioned into contiguous stages.
+
+TPU-first execution design (the deliberate departure from the reference's
+per-stage processes + p2p sends, ``pipe/engine.py``/``p2p.py``): all stages
+run in ONE SPMD program. The homogeneous "body" layers are initialized
+per-layer and stacked ``[num_stages, layers_per_stage, ...]`` with the stage
+axis sharded over the ``pipe`` mesh axis; a ``shard_map`` (manual over
+``pipe`` only) runs the classic fill-drain schedule as a ``lax.scan`` whose
+step rotates activations to the next stage with ``lax.ppermute``. Reverse-mode
+AD through the scan yields the backward pipeline automatically (ppermute
+transposes to the reverse ring) — there is no hand-written instruction
+interpreter, no tensor-meta exchange, and tied-weight gradients sum by
+autodiff instead of ``allreduce_tied_weight_gradients`` (``module.py:417``).
+
+Layer contract: prefix/suffix layers are unary flax modules (or tied specs);
+body layers map a hidden state to a same-shaped hidden state. Embedding-like
+prefixes run on every stage but only stage 0's result enters the pipe (cheap
+relative to the body; XLA may dedupe); same for the suffix/loss on the last
+stage.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..utils.logging import log_dist
+
+
+class LayerSpec:
+    """Delayed-construction layer (reference ``LayerSpec`` ``module.py:23``):
+    stores class + args so a 175B layer list can be declared without
+    materializing weights. In JAX, flax modules are weightless descriptors
+    anyway, but the spec keeps API parity and the lazy ``build``."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not isinstance(typename, type):
+            raise RuntimeError("LayerSpec requires a class (e.g. flax nn.Module subclass)")
+
+    def build(self, name: Optional[str] = None, log: bool = False):
+        if log:
+            log_dist(f"building {repr(self)}", ranks=[0])
+        kwargs = dict(self.module_kwargs)
+        if name is not None:
+            kwargs.setdefault("name", name)
+        return self.typename(*self.module_args, **kwargs)
+
+    def signature(self) -> str:
+        """Homogeneity key: specs with equal signatures form the pipelined
+        body (same class + constructor args ⇒ same param shapes)."""
+        return f"{self.typename.__module__}.{self.typename.__name__}" \
+               f"({self.module_args!r},{sorted(self.module_kwargs.items())!r})"
+
+    def __repr__(self) -> str:
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Reference ``TiedLayerSpec`` ``module.py:71``: layers sharing ``key``
+    share one parameter subtree (e.g. embedding ↔ LM head). ``forward_fn``
+    overrides the module apply for secondary uses — e.g.
+    ``lambda module, params, x: x @ params['embedding'].T``."""
+
+    def __init__(self, key, typename, *module_args, forward_fn: Optional[Callable] = None,
+                 tied_weight_attr: str = "embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+    def signature(self) -> str:
+        return f"tied:{self.key}:" + super().signature()
+
+
+def _as_spec(layer) -> LayerSpec:
+    if isinstance(layer, LayerSpec):
+        return layer
+    if isinstance(layer, type):
+        return LayerSpec(layer)
+    raise TypeError(f"pipeline layers must be LayerSpec or module classes, got {layer!r}")
+
+
+class PipelineModule:
+    """Reference ``PipelineModule`` (``module.py:85``).
+
+    ``layers``: list of ``LayerSpec``/``TiedLayerSpec``. The longest run of
+    identically-signed specs is the pipelined body and must divide evenly by
+    ``num_stages``; layers before/after it are the prefix/suffix, assigned to
+    the first/last stage (reference ``_partition_layers`` ``module.py:361``
+    with ``method='uniform'`` — 'parameters' balancing is moot for a
+    homogeneous body, which is the only shape the reference pipelines in
+    practice, e.g. Megatron GPT blocks).
+
+    ``loss_fn(outputs, labels) -> scalar`` computes the per-microbatch loss on
+    the last stage (reference: ``loss_fn`` ctor arg).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: int, loss_fn: Callable,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0, topology=None):
+        self.specs: List[LayerSpec] = [_as_spec(l) for l in layers]
+        self.num_stages = int(num_stages)
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        if num_stages < 1:
+            raise ValueError("num_stages must be >= 1")
+
+        sigs = [s.signature() for s in self.specs]
+        start, length = self._longest_run(sigs)
+        n_body = length
+        if self.num_stages > 1 and n_body % self.num_stages != 0:
+            raise ValueError(
+                f"body of {n_body} homogeneous layers does not divide "
+                f"{self.num_stages} stages (reference partitioning would "
+                f"imbalance; rebuild with a divisible layer count)")
+        self._body_slice = (start, start + n_body)
+        self.prefix_specs = self.specs[:start]
+        self.body_specs = self.specs[start:start + n_body]
+        self.suffix_specs = self.specs[start + n_body:]
+        self.layers_per_stage = n_body // self.num_stages if n_body else 0
+
+        self._prefix_modules = [s.build() for s in self.prefix_specs]
+        self._body_module = self.body_specs[0].build() if self.body_specs else None
+        self._suffix_modules = [s.build() for s in self.suffix_specs]
+
+    @staticmethod
+    def _longest_run(sigs: List[str]) -> Tuple[int, int]:
+        best_start, best_len, i = 0, 0, 0
+        while i < len(sigs):
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        return best_start, best_len
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng: jax.Array, example_inputs) -> Dict[str, Any]:
+        """Build the params pytree:
+        ``{prefix: {i: …}, stages: [S, Lp, …]-stacked, suffix: {i: …},
+        tied: {key: …}}``."""
+        params: Dict[str, Any] = {"prefix": {}, "suffix": {}, "tied": {}}
+        x = example_inputs
+
+        def init_rngs(sub):
+            return {"params": sub, "dropout": jax.random.fold_in(sub, 1)}
+
+        def init_seq(specs, modules, bucket):
+            nonlocal x, rng
+            for i, (spec, module) in enumerate(zip(specs, modules)):
+                rng, sub = jax.random.split(rng)
+                if isinstance(spec, TiedLayerSpec):
+                    if spec.key not in params["tied"]:
+                        variables = module.init(init_rngs(sub), x)
+                        params["tied"][spec.key] = variables.get("params", variables)
+                    x = self._apply_spec(spec, module, params["tied"][spec.key], x,
+                                         jax.random.fold_in(sub, 2))
+                else:
+                    variables = module.init(init_rngs(sub), x)
+                    p = variables.get("params", variables)
+                    params[bucket][str(i)] = p
+                    x = module.apply({"params": p}, x,
+                                     rngs={"dropout": jax.random.fold_in(sub, 2)})
+
+        init_seq(self.prefix_specs, self._prefix_modules, "prefix")
+
+        if self.body_specs:
+            layer_params = []
+            for li in range(len(self.body_specs)):
+                rng, sub = jax.random.split(rng)
+                variables = self._body_module.init(init_rngs(sub), x)
+                layer_params.append(variables.get("params", variables))
+            stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *layer_params)
+            S, Lp = self.num_stages, self.layers_per_stage
+            params["stages"] = jax.tree_util.tree_map(
+                lambda a: a.reshape((S, Lp) + a.shape[1:]), stacked)
+            x = self._body_module.apply({"params": layer_params[0]}, x,
+                                        rngs={"dropout": rng})  # shape probe
+
+        init_seq(self.suffix_specs, self._suffix_modules, "suffix")
+        return {k: v for k, v in params.items() if v}
+
+    @staticmethod
+    def _apply_spec(spec, module, p, x, rng=None):
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(module, p, x)
+        kwargs = {} if rng is None else {"rngs": {"dropout": rng}}
+        return module.apply({"params": p}, x, **kwargs)
+
+    # ------------------------------------------------------------------
+    # forward pieces used by the SPMD pipeline
+    # ------------------------------------------------------------------
+
+    def _apply_seq(self, specs, modules, params, bucket, x, rng=None):
+        for i, (spec, module) in enumerate(zip(specs, modules)):
+            if isinstance(spec, TiedLayerSpec):
+                p = params["tied"][spec.key]
+            else:
+                p = params[bucket][str(i)]
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = self._apply_spec(spec, module, p, x, sub)
+        return x
+
+    def apply_prefix(self, params, x, rng=None):
+        return self._apply_seq(self.prefix_specs, self._prefix_modules, params,
+                               "prefix", x, rng)
+
+    def apply_suffix(self, params, x, rng=None):
+        rng = None if rng is None else jax.random.fold_in(rng, 7)
+        return self._apply_seq(self.suffix_specs, self._suffix_modules, params,
+                               "suffix", x, rng)
+
+    def apply_stage(self, stage_params, x, rng=None):
+        """Run this stage's body layers (leaves ``[n_layers, ...]``)."""
+        body = self._body_module
+        n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+        def layer_step(h, xs):
+            p_l, i = xs
+            kwargs = {} if rng is None else {"rngs": {"dropout": jax.random.fold_in(rng, i)}}
+            return body.apply({"params": p_l}, h, **kwargs), None
+
+        if self.activation_checkpoint_interval:
+            layer_step = jax.checkpoint(layer_step, prevent_cse=False)
+        x, _ = jax.lax.scan(layer_step, x, (stage_params, jnp.arange(100, 100 + n)))
+        return x
+
+    def apply_sequential(self, params, x, rng=None):
+        """Non-pipelined reference execution (used by tests / num_stages==1)."""
+        x = self.apply_prefix(params, x, rng)
+        if self.body_specs:
+            flat = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), params["stages"])
+            x = self.apply_stage(flat, x, rng)
+        return self.apply_suffix(params, x, rng)
+
+    # ------------------------------------------------------------------
+    # sharding
+    # ------------------------------------------------------------------
+
+    def partition_rules(self):
+        """Engine partition rules: stage-stacked leaves ride the ``pipe``
+        axis; ZeRO overlays further sharding on unsharded dims."""
+        return [(r"^stages/", P("pipe"))]
+
+    def in_specs(self, params) -> Dict[str, Any]:
+        """shard_map in_specs tree-prefix for the params dict."""
+        return {k: (P("pipe") if k == "stages" else P()) for k in params}
+
+    def __len__(self) -> int:
+        return len(self.specs)

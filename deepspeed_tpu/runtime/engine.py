@@ -44,6 +44,19 @@ BACKWARD_MICRO_TIMER = "bwd_microstep"
 STEP_MICRO_TIMER = "step_microstep"
 
 
+def load_config_dict(config):
+    """Path/dict → config dict, with duplicate-key rejection (reference:
+    ``DeepSpeedConfig.__init__`` json loading)."""
+    if isinstance(config, (str, os.PathLike)):
+        import json as _json
+
+        from .config_utils import dict_raise_error_on_duplicate_keys
+
+        with open(config) as _f:
+            return _json.load(_f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+    return config
+
+
 @struct.dataclass
 class TrainState:
     """All mutable training state, as one donated pytree."""
@@ -75,14 +88,7 @@ class DeepSpeedEngine:
 
         # ---- config dict (load file path up front so "parallel" can size
         # the mesh before the engine config is built) ----------------------
-        if isinstance(config, (str, os.PathLike)):
-            import json as _json
-
-            from .config_utils import dict_raise_error_on_duplicate_keys
-
-            with open(config) as _f:
-                config = _json.load(
-                    _f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        config = load_config_dict(config)
 
         # ---- mesh -------------------------------------------------------
         if mesh is None:
@@ -559,11 +565,32 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     if config is None and args is not None and getattr(args, "deepspeed_config", None):
         config = args.deepspeed_config
 
-    engine = DeepSpeedEngine(model=model, config=config, loss_fn=loss_fn,
-                             model_parameters=model_parameters, example_batch=example_batch,
-                             partition_rules=partition_rules, optimizer=optimizer,
-                             lr_scheduler=lr_scheduler, mesh=mesh, rng=rng,
-                             dist_init_required=dist_init_required)
+    from ..pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        # reference dispatches PipelineModule → PipelineEngine
+        # (deepspeed/__init__.py:126-146)
+        from ..pipe.engine import PipelineEngine
+
+        unsupported = {"model_parameters": model_parameters, "loss_fn": loss_fn,
+                       "partition_rules": partition_rules}
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if bad:
+            raise ValueError(
+                f"initialize(model=PipelineModule) does not accept {bad}: the "
+                "pipeline module owns its params/loss/partitioning (use "
+                "engine.load_checkpoint to restore weights)")
+        engine = PipelineEngine(model=model, config=config, example_batch=example_batch,
+                                mesh=mesh, rng=rng, optimizer=optimizer,
+                                lr_scheduler=lr_scheduler,
+                                dist_init_required=dist_init_required)
+    else:
+        engine = DeepSpeedEngine(model=model, config=config, loss_fn=loss_fn,
+                                 model_parameters=model_parameters,
+                                 example_batch=example_batch,
+                                 partition_rules=partition_rules, optimizer=optimizer,
+                                 lr_scheduler=lr_scheduler, mesh=mesh, rng=rng,
+                                 dist_init_required=dist_init_required)
 
     dataloader = None
     if training_data is not None:
