@@ -87,6 +87,14 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
+    if attention_impl == "ulysses" and not use_dropout:
+        from ..sequence.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal, bias=bias)
+    if attention_impl == "ring" and bias is None and not use_dropout:
+        from ..sequence.ring import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
 
     depth = q.shape[-1]
     scale = 1.0 / np.sqrt(depth)

@@ -197,11 +197,16 @@ class PipelineEngine(DeepSpeedEngine):
 
     def train_batch(self, data_iter: Optional[Iterator] = None, batch=None):
         """One optimizer step over ``micro_batches`` microbatches
-        (reference ``train_batch`` ``pipe/engine.py:294``)."""
+        (reference ``train_batch`` ``pipe/engine.py:294``). An iterator must
+        yield microbatches (leading dim = micro_batch_size * dp); this pulls
+        ``micro_batches`` of them per step, like the reference."""
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or data iterator")
-            batch = next(data_iter)
+            micro = [self._canonical_batch(next(data_iter))
+                     for _ in range(self.micro_batches)]
+            batch = {k: np.concatenate([np.asarray(m[k]) for m in micro])
+                     for k in micro[0]}
         batch = self._canonical_batch(batch)
         return super().train_batch(batch=batch)
 
@@ -209,8 +214,12 @@ class PipelineEngine(DeepSpeedEngine):
         return super().eval_batch(self._canonical_batch(batch))
 
     def train_schedule(self, stage_id: int = 0) -> TrainSchedule:
-        """The 1F1B instruction schedule this engine's compiled program
-        realizes as a scan (for analysis/inspection)."""
+        """The reference 1F1B instruction schedule at this configuration, for
+        analysis. NOTE: the compiled program realizes the same compute order
+        but is fill-drain (GPipe-class) in MEMORY — reverse-mode AD keeps all
+        ``micro_batches`` forward activations live unless
+        ``activation_checkpoint_interval`` remats them; 1F1B's warmup+1
+        in-flight bound does NOT describe the executed program."""
         return TrainSchedule(self.micro_batches, self.pipe_module.num_stages, stage_id)
 
     def is_pipe_parallel(self) -> bool:

@@ -23,12 +23,10 @@ relative to the body; XLA may dedupe); same for the suffix/loss on the last
 stage.
 """
 
-import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..utils.logging import log_dist
@@ -111,6 +109,10 @@ class PipelineModule:
         self.specs: List[LayerSpec] = [_as_spec(l) for l in layers]
         self.num_stages = int(num_stages)
         self.loss_fn = loss_fn
+        if partition_method not in ("uniform", "parameters", "type"):
+            raise ValueError(f"unknown partition_method {partition_method!r}")
+        # uniform == parameters for the homogeneous body this class pipelines
+        # (every body layer has identical param count)
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         if num_stages < 1:
@@ -226,7 +228,13 @@ class PipelineModule:
                                "suffix", x, rng)
 
     def apply_stage(self, stage_params, x, rng=None):
-        """Run this stage's body layers (leaves ``[n_layers, ...]``)."""
+        """Run this stage's body layers (leaves ``[n_layers, ...]``).
+
+        ``activation_checkpoint_interval=N`` remats every N-layer chunk
+        (reference ``checkpoint_interval`` in ``exec_range_func``,
+        ``module.py:311``): the scan runs over chunks with the chunk body
+        checkpointed, so only chunk boundaries stay live in backward.
+        """
         body = self._body_module
         n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
 
@@ -235,9 +243,22 @@ class PipelineModule:
             kwargs = {} if rng is None else {"rngs": {"dropout": jax.random.fold_in(rng, i)}}
             return body.apply({"params": p_l}, h, **kwargs), None
 
-        if self.activation_checkpoint_interval:
-            layer_step = jax.checkpoint(layer_step, prevent_cse=False)
-        x, _ = jax.lax.scan(layer_step, x, (stage_params, jnp.arange(100, 100 + n)))
+        interval = self.activation_checkpoint_interval
+        layer_ids = jnp.arange(100, 100 + n)
+        if not interval:
+            x, _ = jax.lax.scan(layer_step, x, (stage_params, layer_ids))
+            return x
+        if n % interval != 0:
+            interval = 1  # fall back to per-layer remat on indivisible chunks
+
+        def chunk_step(h, chunk_xs):
+            h, _ = jax.lax.scan(layer_step, h, chunk_xs)
+            return h, None
+
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((n // interval, interval) + a.shape[1:]),
+            (stage_params, layer_ids))
+        x, _ = jax.lax.scan(jax.checkpoint(chunk_step, prevent_cse=False), x, chunked)
         return x
 
     def apply_sequential(self, params, x, rng=None):

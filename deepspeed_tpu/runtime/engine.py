@@ -31,7 +31,8 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import comm as dist
-from ..parallel.topology import BATCH_AXES, MeshTopology, build_mesh, get_mesh, set_mesh
+from ..parallel.topology import (BATCH_AXES, SEQ_AXIS, MeshTopology, build_mesh,
+                                 get_mesh, set_mesh)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .config import DeepSpeedConfig
@@ -157,7 +158,12 @@ class DeepSpeedEngine:
             skipped_steps=self._replicated)
 
         # ---- compiled step ---------------------------------------------
+        # [gas, batch, tokens...]: batch over data axes; with sequence
+        # parallelism the token dim additionally rides the seq axis
+        # (Ulysses/ring resharding happens inside the attention core).
         self.batch_sharding = NamedSharding(mesh, PartitionSpec(None, BATCH_AXES))
+        self._batch_seq_sharding = NamedSharding(
+            mesh, PartitionSpec(None, BATCH_AXES, SEQ_AXIS))
         self._train_step = self._compile_train_step()
         self._eval_step = None
 
@@ -194,7 +200,9 @@ class DeepSpeedEngine:
     def _init_params(self, example_batch):
         self._rng, init_rng = jax.random.split(self._rng)
         rngs = {"params": init_rng, **self._make_rngs(jax.random.fold_in(init_rng, 7))}
-        variables = self.module.init(rngs, **example_batch)
+        # init under jit: shard_map-based attention (ring) requires a jit
+        # context, and sharded init avoids a replicated host copy
+        variables = jax.jit(self.module.init)(rngs, **example_batch)
         return variables["params"] if "params" in variables else variables
 
     def _build_lr_scheduler(self):
@@ -321,7 +329,9 @@ class DeepSpeedEngine:
 
         return jax.jit(
             train_step,
-            in_shardings=(self.state_shardings, self.batch_sharding, self._replicated),
+            # batch shardings follow the device_put placement from
+            # _shape_batch (per-leaf: token dims ride the seq axis)
+            in_shardings=(self.state_shardings, None, self._replicated),
             out_shardings=(self.state_shardings, self._replicated, self._replicated),
             donate_argnums=(0,),
         )
@@ -345,7 +355,16 @@ class DeepSpeedEngine:
             return x
 
         batch = {k: reshape(v) for k, v in batch.items()}
-        return jax.device_put(batch, self.batch_sharding)
+        return jax.device_put(batch, self._batch_shardings(batch))
+
+    def _batch_shardings(self, batch):
+        """Per-leaf batch shardings: [gas, B, T...] leaves shard tokens over
+        seq; [gas, B] leaves (per-sample scalars) shard over batch only."""
+        if self.seq_world_size <= 1:
+            return jax.tree_util.tree_map(lambda _: self.batch_sharding, batch)
+        return jax.tree_util.tree_map(
+            lambda x: self._batch_seq_sharding if np.ndim(x) >= 3
+            and x.shape[2] % self.seq_world_size == 0 else self.batch_sharding, batch)
 
     def train_batch(self, data_iter: Optional[Iterator] = None,
                     batch: Optional[Dict[str, Any]] = None) -> jnp.ndarray:

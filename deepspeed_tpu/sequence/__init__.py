@@ -1,0 +1,2 @@
+from .ring import ring_attention  # noqa: F401
+from .ulysses import DistributedAttention, ulysses_attention  # noqa: F401

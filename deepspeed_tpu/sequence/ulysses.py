@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism (all_to_all head↔sequence swap).
+
+The 2022 reference has no sequence parallelism (SURVEY §2.3: closest levers
+are block-sparse attention and activation partitioning,
+``ops/sparse_attention/``, ``activation_checkpointing/checkpointing.py:367``);
+this module delivers the modern DeepSpeed-Ulysses capability TPU-natively.
+
+Mechanism: activations flow through the network sharded over the ``seq`` mesh
+axis on the token dimension. Attention needs every query to see every key, so
+around the attention core we RE-shard: tokens gather, heads scatter
+(``[B, T/sp, H, D] → [B, T, H/sp, D]``), compute attention locally per head
+group, and swap back. On GPU this is two explicit all_to_alls
+(DeepSpeed-Ulysses' ``DistributedAttention``); on TPU it is two
+``with_sharding_constraint`` calls — the XLA SPMD partitioner inserts the
+all_to_alls, which ride ICI. Head count must divide the ``seq`` axis size.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import BATCH_AXES, get_mesh
+
+
+def _axis_size(mesh, name: str) -> int:
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
+
+
+def ulysses_attention(q, k, v, causal: bool = False, bias=None,
+                      attention_core=None, mesh=None):
+    """Attention with Ulysses sequence-parallel resharding.
+
+    q/k/v: logical ``[B, T, H, D]`` (token dim sharded over ``seq`` by the
+    surrounding program). ``attention_core(q, k, v, bias, causal)`` defaults
+    to the XLA softmax core; pass the flash kernel for long T.
+
+    Head count must divide ``seq * model`` — like DeepSpeed-Ulysses, an
+    indivisible head count is an error rather than a silent fallback to
+    full-sequence attention (which would quietly reinstate the O(T²) memory
+    SP was enabled to avoid; use ring attention for head-count-independent
+    scaling).
+    """
+    mesh = mesh or get_mesh()
+    sp = _axis_size(mesh, "seq")
+    tp = _axis_size(mesh, "model")
+    H = q.shape[2]
+    if sp > 1 and H % (sp * tp) != 0:
+        raise ValueError(
+            f"Ulysses needs head count ({H}) divisible by seq*model axes "
+            f"({sp}*{tp}); use attention_impl='ring' for this configuration")
+
+    if sp > 1:
+        # heads take over the seq shard: tokens become fully local per shard
+        head_spec = P(BATCH_AXES, None, ("model", "seq"), None)
+        q = jax.lax.with_sharding_constraint(q, jax.NamedSharding(mesh, head_spec))
+        k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
+        v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
+
+    if attention_core is None:
+        from ..models.layers import dot_product_attention
+
+        out = dot_product_attention(q, k, v, bias=bias, causal=causal,
+                                    attention_impl="xla")
+    else:
+        out = attention_core(q, k, v, bias, causal)
+
+    if sp > 1:
+        # back to token-sharded for the rest of the block
+        out = jax.lax.with_sharding_constraint(
+            out, jax.NamedSharding(mesh, P(BATCH_AXES, "seq", "model", None)))
+    return out
+
+
+class DistributedAttention:
+    """Parity shim for DeepSpeed-Ulysses' ``DistributedAttention`` wrapper:
+    wraps any attention core with the head↔seq swap."""
+
+    def __init__(self, attention_core=None, mesh=None, scatter_idx: int = 2,
+                 gather_idx: int = 1):
+        # scatter/gather idx accepted for API parity; the sharding constraint
+        # formulation fixes them at (heads=2, tokens=1)
+        self.attention_core = attention_core
+        self.mesh = mesh
+
+    def __call__(self, q, k, v, causal: bool = False, bias=None):
+        return ulysses_attention(q, k, v, causal=causal, bias=bias,
+                                 attention_core=self.attention_core, mesh=self.mesh)

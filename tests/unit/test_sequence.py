@@ -1,0 +1,111 @@
+"""Long-context / sequence-parallelism tests (Ulysses + ring attention).
+
+The reference has no SP (SURVEY §2.3); correctness bar here is numerical
+parity with plain attention under real seq-axis sharding on the 8-device CPU
+mesh, forward and backward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, T, H, D).astype(np.float32) * 0.3)
+    return mk(), mk(), mk()
+
+
+def _plain(q, k, v, causal):
+    from deepspeed_tpu.models.layers import dot_product_attention
+
+    return dot_product_attention(q, k, v, causal=causal, attention_impl="xla")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_plain(causal):
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ring_attention
+
+    mesh = build_mesh(seq=4, data=2)
+    set_mesh(mesh)
+    q, k, v = _qkv()
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=causal,
+                                                 mesh=mesh))(q, k, v)
+    ref = _plain(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_backward_matches_plain():
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ring_attention
+
+    mesh = build_mesh(seq=4)
+    set_mesh(mesh)
+    q, k, v = _qkv(T=16)
+
+    g_ring = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
+        ring_attention(a, b, c, causal=True, mesh=mesh) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(_plain(a, b, c, True) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_plain(causal):
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ulysses_attention
+
+    mesh = build_mesh(seq=4, data=2)
+    set_mesh(mesh)
+    q, k, v = _qkv()  # H=4 divisible by seq=4
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, causal=causal,
+                                                    mesh=mesh))(q, k, v)
+    ref = _plain(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_no_seq_axis_falls_back():
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ring_attention
+
+    mesh = build_mesh(data=8)
+    set_mesh(mesh)
+    q, k, v = _qkv(T=16)
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=True,
+                                                 mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_plain(q, k, v, True)),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_llama_trains_with_sequence_parallelism(impl):
+    """End-to-end: Llama on a seq=4 mesh, loss matches the seq=1 run."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.parallel import build_mesh
+
+    cfg = LlamaConfig.tiny(attention_impl=impl, remat=False)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32))
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+
+    def run(mesh):
+        model = LlamaForCausalLM(cfg)
+        engine, *_ = ds.initialize(
+            model=model, config=config, mesh=mesh,
+            example_batch={"input_ids": ids[:2], "labels": ids[:2]},
+            partition_rules=LlamaForCausalLM.partition_rules(cfg))
+        return [float(engine.train_batch(batch={"input_ids": ids, "labels": ids}))
+                for _ in range(3)]
+
+    losses_sp = run(build_mesh(seq=4, data=2))
+    losses_ref = run(build_mesh(data=8))
+    np.testing.assert_allclose(losses_sp, losses_ref, rtol=2e-4)
+    assert losses_sp[-1] < losses_sp[0]
