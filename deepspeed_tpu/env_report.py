@@ -1,0 +1,67 @@
+"""Environment / op-compatibility report (``ds_report``).
+
+Counterpart of ``deepspeed/env_report.py`` (op install/compat matrix :140).
+Run: ``python -m deepspeed_tpu.env_report``.
+"""
+
+import os
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def op_report():
+    from op_builder import ALL_OPS
+
+    print("-" * 60)
+    print("native op name" + "." * 16 + "compatible" + "." * 6 + "built")
+    print("-" * 60)
+    for name, builder in ALL_OPS.items():
+        compatible = builder.is_compatible()
+        built = os.path.exists(builder.lib_path())
+        print(f"{name:<30}{GREEN_OK if compatible else RED_NO:<20}"
+              f"{GREEN_OK if built else '[not built]'}")
+    print("-" * 60)
+
+
+def env_info():
+    import jax
+    import jaxlib
+
+    import deepspeed_tpu
+
+    print(f"deepspeed_tpu version: {deepspeed_tpu.__version__}")
+    print(f"python version: {sys.version.split()[0]}")
+    print(f"jax version: {jax.__version__}; jaxlib: {jaxlib.__version__}")
+    try:
+        devs = jax.devices()
+        print(f"devices: {len(devs)} x {devs[0].device_kind} "
+              f"(platform {devs[0].platform})")
+    except Exception as e:  # no accelerator in this context
+        print(f"devices: unavailable ({e})")
+    try:
+        import flax
+        import optax
+        import orbax.checkpoint
+
+        print(f"flax {flax.__version__}, optax {optax.__version__}")
+    except Exception:
+        pass
+
+
+def main():
+    print("=" * 60)
+    print("DeepSpeed-TPU environment report (ds_report)")
+    print("=" * 60)
+    env_info()
+    op_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -87,11 +87,21 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
-    if attention_impl == "ulysses" and not use_dropout:
+    if attention_impl == "ulysses":
+        if use_dropout:
+            # falling back to plain attention would quietly materialize the
+            # O(T^2) logits sequence parallelism exists to avoid
+            raise NotImplementedError(
+                "attention dropout is not supported with attention_impl="
+                "'ulysses'; set attn dropout to 0")
         from ..sequence.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, causal=causal, bias=bias)
-    if attention_impl == "ring" and bias is None and not use_dropout:
+    if attention_impl == "ring":
+        if use_dropout or bias is not None:
+            raise NotImplementedError(
+                "ring attention supports causal masking only (no additive "
+                "bias / attention dropout); drop padding via the loss mask")
         from ..sequence.ring import ring_attention
 
         return ring_attention(q, k, v, causal=causal)

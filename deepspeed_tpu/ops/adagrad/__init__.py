@@ -1,0 +1,1 @@
+from .cpu_adagrad import DeepSpeedCPUAdagrad  # noqa: F401

@@ -1,0 +1,56 @@
+"""Host-CPU Adagrad (native SIMD kernel). Counterpart of
+``deepspeed/ops/adagrad/cpu_adagrad.py`` / ``csrc/adagrad/cpu_adagrad.cpp``;
+see ``cpu_adam.py`` for the offload rationale."""
+
+import ctypes
+import itertools
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+_ids = itertools.count()
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, params: Iterable[np.ndarray], lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0,
+                 num_threads: int = 0):
+        from op_builder import CPUAdagradBuilder
+
+        self._lib = CPUAdagradBuilder().load()
+        self._id = next(_ids)
+        self.params: List[np.ndarray] = [
+            arr if arr.flags.writeable else arr.copy()
+            for arr in (np.ascontiguousarray(p, np.float32) for p in params)]
+        self.sum_sq = [np.zeros_like(p) for p in self.params]
+        self.lr = lr
+        self.num_threads = num_threads or 1
+        rc = self._lib.ds_adagrad_create(
+            ctypes.c_int(self._id), ctypes.c_float(lr), ctypes.c_float(eps),
+            ctypes.c_float(weight_decay))
+        if rc != 0:
+            raise RuntimeError("ds_adagrad_create failed")
+
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None,
+             bf16_out: Optional[List[np.ndarray]] = None) -> None:
+        for i, g in enumerate(grads):
+            p = self.params[i]
+            g = np.ascontiguousarray(g, np.float32)
+            out = bf16_out[i] if bf16_out is not None else None
+            rc = self._lib.ds_adagrad_step(
+                ctypes.c_int(self._id), ctypes.c_int64(p.size),
+                p.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.sum_sq[i].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_float(-1.0 if lr is None else lr),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+                if out is not None else None,
+                ctypes.c_int(self.num_threads))
+            if rc != 0:
+                raise RuntimeError("ds_adagrad_step failed")
+
+    def __del__(self):
+        try:
+            self._lib.ds_adagrad_destroy(ctypes.c_int(self._id))
+        except Exception:
+            pass

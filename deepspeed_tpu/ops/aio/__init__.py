@@ -1,0 +1,1 @@
+from .handle import AsyncIOHandle, aio_handle  # noqa: F401

@@ -1,0 +1,91 @@
+"""Async file IO handle over the native thread-pool module.
+
+Counterpart of ``deepspeed/ops/aio/__init__.py`` (``aio_handle`` with
+``block_size, queue_depth, single_submit, overlap_events, num_threads`` —
+``csrc/aio/py_lib/deepspeed_py_aio_handle.h:12``) backing NVMe/SSD swap of
+params and optimizer state (ZeRO-Infinity role). Buffers are numpy arrays;
+async ops return immediately and ``wait()`` fences them.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+
+class AsyncIOHandle:
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 single_submit: bool = False, overlap_events: bool = False,
+                 num_threads: int = 1):
+        from op_builder import AsyncIOBuilder
+
+        self._lib = AsyncIOBuilder().load()
+        self._lib.ds_aio_handle_create.restype = ctypes.c_void_p
+        self._lib.ds_aio_pread.restype = ctypes.c_int64
+        self._lib.ds_aio_pwrite.restype = ctypes.c_int64
+        self._lib.ds_aio_wait.restype = ctypes.c_int64
+        self._h = self._lib.ds_aio_handle_create(
+            ctypes.c_int64(block_size), ctypes.c_int(queue_depth),
+            ctypes.c_int(int(single_submit)), ctypes.c_int(int(overlap_events)),
+            ctypes.c_int(num_threads))
+        self.block_size = block_size
+        self.num_threads = num_threads
+
+    def _buf(self, array: np.ndarray):
+        assert array.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+        return array.ctypes.data_as(ctypes.c_void_p)
+
+    def pwrite(self, array: np.ndarray, path: str, offset: int = 0,
+               async_op: bool = False) -> int:
+        rc = self._lib.ds_aio_pwrite(
+            ctypes.c_void_p(self._h), path.encode(), self._buf(array),
+            ctypes.c_int64(array.nbytes), ctypes.c_int64(offset),
+            ctypes.c_int(int(async_op)))
+        if rc < 0:
+            raise OSError(f"aio write failed: {path}")
+        return int(rc)
+
+    def pread(self, array: np.ndarray, path: str, offset: int = 0,
+              async_op: bool = False) -> int:
+        rc = self._lib.ds_aio_pread(
+            ctypes.c_void_p(self._h), path.encode(), self._buf(array),
+            ctypes.c_int64(array.nbytes), ctypes.c_int64(offset),
+            ctypes.c_int(int(async_op)))
+        if rc < 0:
+            raise OSError(f"aio read failed: {path}")
+        return int(rc)
+
+    # reference verb aliases
+    sync_pwrite = pwrite
+    sync_pread = pread
+
+    def async_pwrite(self, array, path, offset: int = 0):
+        return self.pwrite(array, path, offset, async_op=True)
+
+    def async_pread(self, array, path, offset: int = 0):
+        return self.pread(array, path, offset, async_op=True)
+
+    def wait(self) -> int:
+        rc = int(self._lib.ds_aio_wait(ctypes.c_void_p(self._h)))
+        if rc < 0:
+            raise OSError("aio op failed during wait")
+        return rc
+
+    def close(self):
+        if self._h:
+            self._lib.ds_aio_handle_destroy(ctypes.c_void_p(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def aio_handle(block_size: int = 1 << 20, queue_depth: int = 32,
+               single_submit: bool = False, overlap_events: bool = False,
+               num_threads: int = 1) -> AsyncIOHandle:
+    """Reference factory name (``deepspeed.ops.aio.aio_handle``)."""
+    return AsyncIOHandle(block_size, queue_depth, single_submit, overlap_events,
+                         num_threads)

@@ -133,27 +133,57 @@ class DeepSpeedEngine:
 
         # ---- optimizer --------------------------------------------------
         self.lr_scheduler = self._build_lr_scheduler()
-        self.optimizer = self._build_optimizer()
+        off = self._config.zero_config.offload_optimizer
+        self._offload = (off is not None
+                         and str(getattr(off.device, "value", off.device)) != "none")
+        if self._offload and self.fp16_enabled:
+            raise ValueError("offload_optimizer currently supports bf16/fp32 "
+                             "(use bf16 on TPU; fp16 loss scaling is a "
+                             "device-side path)")
+        self.optimizer = None if self._offload else self._build_optimizer()
 
         # ---- shardings (ZeRO policy) ------------------------------------
         params_shapes = jax.eval_shape(lambda: params)
         self.param_shardings, shard_opt = state_shardings(
             params_shapes, mesh, self._config.zero_config, partition_rules)
-        opt_shapes = jax.eval_shape(self.optimizer.init, params_shapes)
-        self.opt_shardings = shard_opt(opt_shapes)
+        if self._offload:
+            self.opt_shardings = ()
+        else:
+            opt_shapes = jax.eval_shape(self.optimizer.init, params_shapes)
+            self.opt_shardings = shard_opt(opt_shapes)
         self._replicated = NamedSharding(mesh, PartitionSpec())
 
         # ---- build + place state ---------------------------------------
-        params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=self.opt_shardings)(params)
+        if self._offload:
+            # host owns fp32 master + moments; device holds bf16 weights only
+            from .zero.offload import HostOffloadOptimizer
+
+            opt_cfg = self._config.optimizer
+            self._host_opt = HostOffloadOptimizer(
+                params,
+                opt_cfg.type if opt_cfg else "AdamW",
+                opt_cfg.params if opt_cfg else {},
+                self._config.zero_config.offload_optimizer,
+                gradient_clipping=self._config.gradient_clipping,
+                lr_scheduler=self.lr_scheduler)
+            params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    p.astype(self.compute_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p, s),
+                params, self.param_shardings)
+            opt_state = ()
+        else:
+            self._host_opt = None
+            params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=self.opt_shardings)(params)
         loss_scale = create_loss_scaler(self._config.fp16) if self.fp16_enabled else None
         self.state = TrainState(step=jnp.zeros([], jnp.int32), params=params,
                                 opt_state=opt_state, loss_scale=loss_scale,
                                 skipped_steps=jnp.zeros([], jnp.int32))
         self.state_shardings = TrainState(
             step=self._replicated, params=self.param_shardings,
-            opt_state=self.opt_shardings,
+            opt_state=self.opt_shardings if not self._offload else (),
             loss_scale=jax.tree_util.tree_map(lambda _: self._replicated, loss_scale),
             skipped_steps=self._replicated)
 
@@ -164,7 +194,11 @@ class DeepSpeedEngine:
         self.batch_sharding = NamedSharding(mesh, PartitionSpec(None, BATCH_AXES))
         self._batch_seq_sharding = NamedSharding(
             mesh, PartitionSpec(None, BATCH_AXES, SEQ_AXIS))
-        self._train_step = self._compile_train_step()
+        if self._offload:
+            self._train_step = None
+            self._grad_step = self._compile_grad_step()
+        else:
+            self._train_step = self._compile_train_step()
         self._eval_step = None
 
         # ---- timers / monitor ------------------------------------------
@@ -336,6 +370,67 @@ class DeepSpeedEngine:
             donate_argnums=(0,),
         )
 
+    def _compile_grad_step(self):
+        """Offload mode: the compiled step produces (grads, loss) only; the
+        optimizer runs on the host (reference: grads → CPU → DeepSpeedCPUAdam,
+        ``stage_1_and_2.py:1027``). Device params are already compute-dtype."""
+        loss_fn = self.loss_fn
+        gas = self.gradient_accumulation_steps
+
+        def compute_loss(params, batch, rng):
+            if loss_fn is not None:
+                loss, aux = loss_fn(params, batch, rng)
+            else:
+                loss, aux = self._default_loss(params, batch, rng)
+            return loss.astype(jnp.float32), loss
+
+        grad_fn = jax.grad(compute_loss, has_aux=True)
+
+        def grad_step(params, batch, rng):
+            if gas > 1:
+                rngs = jax.random.split(rng, gas)
+
+                def body(acc, xs):
+                    mb, r = xs
+                    g, loss = grad_fn(params, mb, r)
+                    acc_g, acc_l = acc
+                    return (jax.tree_util.tree_map(jnp.add, acc_g, g),
+                            acc_l + loss), None
+
+                zero_g = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (sum_g, sum_loss), _ = jax.lax.scan(
+                    body, (zero_g, jnp.float32(0.0)), (batch, rngs))
+                grads = jax.tree_util.tree_map(lambda g: g / gas, sum_g)
+                loss = sum_loss / gas
+            else:
+                squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
+                grads, loss = grad_fn(params, squeezed, rng)
+            return grads, loss
+
+        return jax.jit(grad_step,
+                       in_shardings=(self.param_shardings, None, self._replicated),
+                       out_shardings=(self.param_shardings, self._replicated))
+
+    def _offload_train_batch(self, batch):
+        """Host-optimizer step (ZeRO-Offload)."""
+        batch = self._shape_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        grads, loss = self._grad_step(self.state.params, batch, step_rng)
+        new_params, overflow, _ = self._host_opt.step(jax.device_get(grads))
+        if overflow:
+            self.skipped_steps += 1
+            self.state = self.state.replace(
+                skipped_steps=self.state.skipped_steps + 1)
+        else:
+            dev = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    p.astype(self.compute_dtype)
+                    if np.issubdtype(p.dtype, np.floating) else p, s),
+                new_params, self.param_shardings)
+            self.state = self.state.replace(params=dev, step=self.state.step + 1)
+        return loss
+
     # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
@@ -385,9 +480,12 @@ class DeepSpeedEngine:
             self.timers("train_batch").start()
         self.tput_timer.start()
 
-        batch = self._shape_batch(batch)
-        self._rng, step_rng = jax.random.split(self._rng)
-        self.state, loss, overflow = self._train_step(self.state, batch, step_rng)
+        if self._offload:
+            loss = self._offload_train_batch(batch)
+        else:
+            batch = self._shape_batch(batch)
+            self._rng, step_rng = jax.random.split(self._rng)
+            self.state, loss, overflow = self._train_step(self.state, batch, step_rng)
 
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -525,6 +623,15 @@ class DeepSpeedEngine:
         client_state.update(global_steps=self.global_steps,
                             skipped_steps=self.get_skipped_steps())
         save_train_state(save_dir, tag, self.state, client_state, save_latest=save_latest)
+        if self._offload:
+            # host-side fp32 masters + moments live outside TrainState
+            sd = self._host_opt.state_dict()
+            np.savez(os.path.join(save_dir, f"{tag}.host_optimizer.npz"),
+                     step=sd["step"],
+                     **{f"master_{i}": m for i, m in enumerate(sd["master"])},
+                     **{f"moment_{mi}_{li}": buf
+                        for mi, bank in enumerate(sd["moments"])
+                        for li, buf in enumerate(bank)})
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -537,6 +644,27 @@ class DeepSpeedEngine:
             load_optimizer_states=load_optimizer_states)
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
+        if self._offload:
+            if tag is None:
+                with open(os.path.join(load_dir, "latest")) as f:
+                    tag = f.read().strip()
+            host_path = os.path.join(load_dir, f"{tag}.host_optimizer.npz")
+            if load_optimizer_states and os.path.exists(host_path):
+                z = np.load(host_path)
+                n = len(self._host_opt.master)
+                nbanks = len(self._host_opt._moments)
+                self._host_opt.load_state_dict({
+                    "step": int(z["step"]),
+                    "master": [z[f"master_{i}"] for i in range(n)],
+                    "moments": [[z[f"moment_{mi}_{li}"] for li in range(n)]
+                                for mi in range(nbanks)],
+                })
+            else:
+                # no host state to restore: rebuild masters from the loaded
+                # device params so the next step doesn't clobber them
+                leaves = jax.tree_util.tree_leaves(jax.device_get(state.params))
+                for dst, src in zip(self._host_opt.master, leaves):
+                    np.copyto(dst, np.asarray(src, np.float32).ravel())
         return load_dir, client_state
 
 

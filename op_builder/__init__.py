@@ -1,0 +1,2 @@
+from .builder import (ALL_OPS, AsyncIOBuilder, CPUAdagradBuilder,  # noqa: F401
+                      CPUAdamBuilder, OpBuilder, get_default_compute_capabilities)

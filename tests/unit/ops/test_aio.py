@@ -1,0 +1,72 @@
+"""Async IO handle tests (reference: ``tests/unit/ops/aio`` roundtrips)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _have_compiler():
+    from op_builder import AsyncIOBuilder
+
+    return AsyncIOBuilder().is_compatible()
+
+
+pytestmark = pytest.mark.skipif(not _have_compiler(), reason="no C++ compiler")
+
+
+def test_sync_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(block_size=4096, num_threads=2)
+    data = np.random.RandomState(0).randn(100_000).astype(np.float32)
+    path = str(tmp_path / "swap.bin")
+    h.pwrite(data, path)
+    out = np.zeros_like(data)
+    h.pread(out, path)
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_async_roundtrip_with_wait(tmp_path):
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(block_size=1 << 16, num_threads=4)
+    arrays = [np.random.RandomState(i).randn(50_000).astype(np.float32)
+              for i in range(4)]
+    paths = [str(tmp_path / f"p{i}.bin") for i in range(4)]
+    nsub = sum(h.async_pwrite(a, p) for a, p in zip(arrays, paths))
+    assert nsub >= 4
+    assert h.wait() == nsub
+
+    outs = [np.zeros_like(a) for a in arrays]
+    for o, p in zip(outs, paths):
+        h.async_pread(o, p)
+    h.wait()
+    for o, a in zip(outs, arrays):
+        np.testing.assert_array_equal(o, a)
+    h.close()
+
+
+def test_offset_read_write(tmp_path):
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(num_threads=1)
+    path = str(tmp_path / "off.bin")
+    first = np.arange(1000, dtype=np.float32)
+    second = np.arange(1000, 2000, dtype=np.float32)
+    h.pwrite(first, path, offset=0)
+    h.pwrite(second, path, offset=first.nbytes)
+    out = np.zeros(1000, np.float32)
+    h.pread(out, path, offset=first.nbytes)
+    np.testing.assert_array_equal(out, second)
+    h.close()
+
+
+def test_read_missing_file_raises(tmp_path):
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle()
+    with pytest.raises(OSError):
+        h.pread(np.zeros(10, np.float32), str(tmp_path / "missing.bin"))
+    h.close()
