@@ -58,6 +58,51 @@ def load_config_dict(config):
     return config
 
 
+def _flat_name(kp) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+
+class _EngineCheckpointMixin:
+    """Model-export paths (reference ``engine.py:3198-3268``)."""
+
+    def module_state_dict(self):
+        """Current params as a host pytree (reference ``module_state_dict``)."""
+        return jax.device_get(self.state.params)
+
+    def _consolidated_16bit_state_dict(self):
+        """Gather params to host at bf16 (reference
+        ``_zero3_consolidated_16bit_state_dict`` :3198 — under ZeRO-3 this IS
+        the consolidation; device_get gathers every shard)."""
+        return jax.tree_util.tree_map(
+            lambda p: np.asarray(jax.device_get(p)).astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else jax.device_get(p),
+            self.state.params)
+
+    def save_16bit_model(self, save_dir: str, output_file: str = "pytorch_model.npz"):
+        """Write a consolidated half-precision weights file (reference
+        ``save_16bit_model`` :3268). Stored as a flat npz keyed by param path
+        (bf16 saved as uint16 bit patterns + a dtype manifest)."""
+        os.makedirs(save_dir, exist_ok=True)
+        sd = self._consolidated_16bit_state_dict()
+        flat = {}
+        dtypes = {}
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(sd)[0]:
+            name = _flat_name(kp)
+            arr = np.asarray(leaf)
+            if arr.dtype == jnp.bfloat16:
+                flat[name] = arr.view(np.uint16)
+                dtypes[name] = "bfloat16"
+            else:
+                flat[name] = arr
+                dtypes[name] = str(arr.dtype)
+        path = os.path.join(save_dir, output_file)
+        np.savez(path, __dtypes__=np.asarray([f"{k}={v}" for k, v in dtypes.items()]),
+                 **flat)
+        log_dist(f"saved 16-bit model to {path}", ranks=[0])
+        return True
+
+
+
 @struct.dataclass
 class TrainState:
     """All mutable training state, as one donated pytree."""
@@ -69,7 +114,7 @@ class TrainState:
     skipped_steps: jnp.ndarray
 
 
-class DeepSpeedEngine:
+class DeepSpeedEngine(_EngineCheckpointMixin):
     """See module docstring. Construct via ``deepspeed_tpu.initialize``."""
 
     def __init__(self, model=None, config=None, loss_fn: Optional[Callable] = None,

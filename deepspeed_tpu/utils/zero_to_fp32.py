@@ -1,0 +1,88 @@
+"""Reconstruct a full fp32 state dict from a training checkpoint.
+
+Counterpart of ``deepspeed/utils/zero_to_fp32.py`` (:153 ``get_fp32_state_dict
+_from_zero_checkpoint``, :360 CLI). The reference must merge per-rank ZeRO
+partition pickles offline; orbax/tensorstore checkpoints are sharding-
+agnostic, so "consolidation" is simply a host-resident restore of the params
+subtree at fp32 — any ZeRO stage, any mesh the checkpoint was written with.
+
+CLI: ``python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <out.npz> [tag]``
+"""
+
+import os
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag=")
+        with open(latest) as f:
+            tag = f.read().strip()
+    return tag
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None
+                                             ) -> Dict[str, np.ndarray]:
+    """→ flat ``{'path/to/param': fp32 ndarray}`` (reference :153)."""
+    from ..checkpoint.engine import load_pytree
+
+    tag = _resolve_tag(checkpoint_dir, tag)
+    path = os.path.join(os.path.abspath(checkpoint_dir), tag)
+    state = load_pytree(path)
+    params = state["params"] if isinstance(state, dict) and "params" in state else state
+
+    import jax
+
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        flat[name] = np.asarray(jax.device_get(leaf), np.float32)
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag: Optional[str] = None) -> None:
+    """Reference :287: write the consolidated fp32 dict to one file (.npz)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    print(f"wrote {len(sd)} tensors / {total:,} params to {output_file}")
+
+
+def load_state_dict_from_zero_checkpoint(model_params: Any, checkpoint_dir: str,
+                                         tag: Optional[str] = None) -> Any:
+    """Populate a params pytree template with checkpoint fp32 values
+    (reference :184 ``load_state_dict_from_zero_checkpoint``)."""
+    import jax
+
+    flat = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+
+    def fill(kp, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        if name not in flat:
+            raise KeyError(f"checkpoint missing param {name}")
+        src = flat[name]
+        if tuple(src.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"shape mismatch for {name}: ckpt {src.shape} "
+                             f"vs model {np.shape(leaf)}")
+        return src.astype(np.asarray(leaf).dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, model_params)
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        sys.exit(1)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        sys.argv[1], sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Checkpoint subsystem tests.
+
+TPU translation of the reference's ``tests/unit/checkpoint/`` suite: ZeRO
+round-trips per stage, mesh (DP/TP) resize on load, consolidated fp32 export
+(zero_to_fp32), and 16-bit model export.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _engine(config_extra=None, mesh=None, seed=0):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+    }
+    config.update(config_extra or {})
+    engine, *_ = ds.initialize(
+        model=model, config=config, mesh=mesh,
+        example_batch={"input_ids": ids[:2], "labels": ids[:2]},
+        partition_rules=LlamaForCausalLM.partition_rules(cfg),
+        rng=jax.random.PRNGKey(seed))
+    return engine, {"input_ids": ids, "labels": ids}
+
+
+@pytest.mark.parametrize("stage", [0, 1, 3])
+def test_zero_checkpoint_roundtrip(tmp_path, stage):
+    e1, batch = _engine({"zero_optimization": {"stage": stage}})
+    for _ in range(3):
+        e1.train_batch(batch=batch)
+    e1.save_checkpoint(str(tmp_path), tag="ck")
+    cont1 = [float(e1.train_batch(batch=batch)) for _ in range(2)]
+
+    e2, _ = _engine({"zero_optimization": {"stage": stage}}, seed=1)
+    e2.load_checkpoint(str(tmp_path), tag="ck")
+    assert e2.global_steps == 3
+    cont2 = [float(e2.train_batch(batch=batch)) for _ in range(2)]
+    np.testing.assert_allclose(cont2, cont1, rtol=1e-4)
+
+
+def test_checkpoint_mesh_resize_on_load(tmp_path):
+    """Save under data=8/ZeRO-3, restore under data=2 x model=4 TP — the
+    reference needs offline reshape tools for this (deepspeed/checkpoint/);
+    orbax restores any sharding directly."""
+    from deepspeed_tpu.parallel import build_mesh
+
+    e1, batch = _engine({"zero_optimization": {"stage": 3}},
+                        mesh=build_mesh(data=8))
+    for _ in range(2):
+        e1.train_batch(batch=batch)
+    e1.save_checkpoint(str(tmp_path), tag="ck")
+    ref = [float(e1.train_batch(batch=batch)) for _ in range(2)]
+
+    e2, _ = _engine({"zero_optimization": {"stage": 1}},
+                    mesh=build_mesh(data=2, model=4), seed=1)
+    e2.load_checkpoint(str(tmp_path), tag="ck")
+    got = [float(e2.train_batch(batch=batch)) for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_zero_to_fp32_consolidation(tmp_path):
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        convert_zero_checkpoint_to_fp32_state_dict,
+        get_fp32_state_dict_from_zero_checkpoint,
+        load_state_dict_from_zero_checkpoint)
+
+    e1, batch = _engine({"zero_optimization": {"stage": 3}})
+    e1.train_batch(batch=batch)
+    e1.save_checkpoint(str(tmp_path))  # writes 'latest'
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    live = e1.module_state_dict()
+    flat_live = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(live)[0]:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        flat_live[name] = np.asarray(leaf, np.float32)
+    assert set(sd) == set(flat_live)
+    for k in sd:
+        np.testing.assert_allclose(sd[k], flat_live[k], rtol=1e-6)
+
+    out = str(tmp_path / "consolidated.npz")
+    convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path), out)
+    assert os.path.exists(out)
+
+    # template fill
+    filled = load_state_dict_from_zero_checkpoint(live, str(tmp_path))
+    for a, b in zip(jax.tree_util.tree_leaves(filled),
+                    jax.tree_util.tree_leaves(live)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_save_16bit_model(tmp_path):
+    e1, batch = _engine({"bf16": {"enabled": True},
+                         "zero_optimization": {"stage": 3}})
+    e1.train_batch(batch=batch)
+    assert e1.save_16bit_model(str(tmp_path), "model16.npz")
+    z = np.load(tmp_path / "model16.npz")
+    names = [n for n in z.files if n != "__dtypes__"]
+    assert len(names) == len(jax.tree_util.tree_leaves(e1.state.params))
+    dtypes = dict(s.split("=") for s in z["__dtypes__"])
+    # floating leaves recorded as bf16 bit patterns
+    assert any(v == "bfloat16" for v in dtypes.values())
+    # spot-check one tensor round-trips against live fp32 params
+    some = next(n for n, v in dtypes.items() if v == "bfloat16")
+    live = e1.module_state_dict()
+    node = live
+    for part in some.split("/"):
+        node = node[part]
+    restored = z[some].view(np.uint16).astype(np.uint32) << 16
+    restored = restored.view(np.float32).reshape(np.shape(node))
+    np.testing.assert_allclose(restored, np.asarray(node, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_pipeline_engine_checkpoint_roundtrip(tmp_path):
+    import flax.linen as nn
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.layers import cross_entropy_loss
+    from deepspeed_tpu.pipe import LayerSpec, PipelineModule
+
+    class Embed(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            return nn.Embed(64, 32)(ids)
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x + nn.Dense(32)(nn.tanh(x))
+
+    class Head(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(64, use_bias=False)(x)
+
+    def make(seed):
+        pipe = PipelineModule([LayerSpec(Embed), LayerSpec(Block), LayerSpec(Block),
+                               LayerSpec(Head)], num_stages=2,
+                              loss_fn=cross_entropy_loss)
+        ids = np.random.RandomState(0).randint(0, 64, (8, 8))
+        engine, *_ = ds.initialize(
+            model=pipe, config={"train_batch_size": 8,
+                                "gradient_accumulation_steps": 2,
+                                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                                "parallel": {"pipe": 2}, "steps_per_print": 0},
+            example_batch={"inputs": ids, "labels": ids},
+            rng=jax.random.PRNGKey(seed))
+        return engine, (ids, ids)
+
+    e1, batch = make(0)
+    for _ in range(2):
+        e1.train_batch(batch=batch)
+    e1.save_checkpoint(str(tmp_path), tag="ck")
+    ref = [float(e1.train_batch(batch=batch)) for _ in range(2)]
+
+    e2, _ = make(1)
+    e2.load_checkpoint(str(tmp_path), tag="ck")
+    got = [float(e2.train_batch(batch=batch)) for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
