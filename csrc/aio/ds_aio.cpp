@@ -26,13 +26,25 @@
 
 namespace {
 
-struct Op {
-  int64_t id;
-  bool write;
+// One submit() call = one Group. The group owns the file descriptor and its
+// own error count; the worker finishing the group's last sub-op closes the fd
+// (mirrors the reference's close(completed_op->_fd) on completion), so long
+// async runs cannot exhaust the process fd limit, and one group's failure
+// does not bleed into other submits' return codes.
+struct Group {
   int fd;
+  bool async_owned;  // worker deletes the group after the last sub-op
+  int64_t remaining;  // guarded by Handle::mu
+  std::atomic<int64_t> errors{0};
+  Group(int fd_, bool async_, int64_t n) : fd(fd_), async_owned(async_), remaining(n) {}
+};
+
+struct Op {
+  bool write;
   char* buf;
   int64_t nbytes;
   int64_t offset;
+  Group* group;
 };
 
 struct Handle {
@@ -45,7 +57,7 @@ struct Handle {
   std::condition_variable done_cv;
   int64_t inflight = 0;
   int64_t completed = 0;
-  std::atomic<int64_t> errors{0};
+  int64_t async_group_errors = 0;  // failed async groups since last wait()
   bool shutdown = false;
 
   void worker() {
@@ -63,18 +75,29 @@ struct Handle {
         int64_t chunk = op.nbytes - done;
         if (block_size > 0 && chunk > block_size) chunk = block_size;
         ssize_t r = op.write
-                        ? pwrite(op.fd, op.buf + done, chunk, op.offset + done)
-                        : pread(op.fd, op.buf + done, chunk, op.offset + done);
+                        ? pwrite(op.group->fd, op.buf + done, chunk, op.offset + done)
+                        : pread(op.group->fd, op.buf + done, chunk, op.offset + done);
         if (r <= 0) {
-          errors.fetch_add(1);
+          op.group->errors.fetch_add(1);
           break;
         }
         done += r;
       }
       {
+        // All group completion accounting happens inside one critical
+        // section: a sync submitter only observes remaining==0 while holding
+        // mu, i.e. strictly after the close/delete below have finished, so it
+        // can never free the Group while this worker still touches it.
         std::lock_guard<std::mutex> lk(mu);
         --inflight;
         ++completed;
+        if (--op.group->remaining == 0) {
+          close(op.group->fd);
+          if (op.group->async_owned) {
+            if (op.group->errors.load()) ++async_group_errors;
+            delete op.group;
+          }
+        }
       }
       done_cv.notify_all();
     }
@@ -94,9 +117,15 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
   std::vector<Op> ops;
   for (int64_t off = 0; off < nbytes; off += sub) {
     int64_t len = off + sub <= nbytes ? sub : nbytes - off;
-    ops.push_back(Op{0, write, fd, static_cast<char*>(buf) + off, len,
-                     offset + off});
+    ops.push_back(Op{write, static_cast<char*>(buf) + off, len, offset + off,
+                     nullptr});
   }
+  if (ops.empty()) {  // zero-byte op: no worker will ever close the fd
+    close(fd);
+    return 0;
+  }
+  auto* group = new Group(fd, async_op != 0, static_cast<int64_t>(ops.size()));
+  for (auto& op : ops) op.group = group;
   {
     std::lock_guard<std::mutex> lk(h->mu);
     for (auto& op : ops) h->queue.push_back(op);
@@ -104,13 +133,15 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
   }
   h->cv.notify_all();
   if (!async_op) {
-    std::unique_lock<std::mutex> lk(h->mu);
-    h->done_cv.wait(lk, [&] { return h->inflight == 0; });
-    close(fd);
-    return h->errors.load() ? -1 : 0;
+    int64_t rc;
+    {
+      std::unique_lock<std::mutex> lk(h->mu);
+      h->done_cv.wait(lk, [&] { return group->remaining == 0; });
+      rc = group->errors.load() ? -1 : 0;
+    }
+    delete group;  // worker already closed the fd
+    return rc;
   }
-  // async: fd intentionally left open until wait() — tracked crudely by
-  // letting the OS reap it at destroy; callers use wait() before reuse.
   return static_cast<int64_t>(ops.size());
 }
 
@@ -158,18 +189,16 @@ int64_t ds_aio_pwrite(void* handle, const char* path, void* buffer,
 }
 
 // Block until all queued ops finish; returns completed count since the last
-// wait, or -1 if any op errored.
+// wait, or -1 if any async group errored since the last wait.
 int64_t ds_aio_wait(void* handle) {
   auto* h = static_cast<Handle*>(handle);
   std::unique_lock<std::mutex> lk(h->mu);
   h->done_cv.wait(lk, [&] { return h->inflight == 0; });
   int64_t done = h->completed;
   h->completed = 0;
-  if (h->errors.load()) {
-    h->errors.store(0);
-    return -1;
-  }
-  return done;
+  int64_t failed = h->async_group_errors;
+  h->async_group_errors = 0;
+  return failed ? -1 : done;
 }
 
 }  // extern "C"

@@ -10,6 +10,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "bf16.h"
+
 #if defined(__AVX512F__) || defined(__AVX2__)
 #include <immintrin.h>
 #endif
@@ -25,12 +27,6 @@ struct AdagradState {
 std::unordered_map<int, AdagradState> g_states;
 std::mutex g_mu;
 
-inline uint16_t f32_to_bf16(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, sizeof(bits));
-  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-  return static_cast<uint16_t>((bits + rounding) >> 16);
-}
 
 void adagrad_scalar(const AdagradState& s, float lr, float* p, const float* g,
                     float* h, int64_t begin, int64_t end, uint16_t* bf16_out) {

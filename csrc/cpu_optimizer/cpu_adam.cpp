@@ -20,6 +20,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "bf16.h"
+
 #if defined(__AVX512F__) || defined(__AVX2__)
 #include <immintrin.h>
 #endif
@@ -38,13 +40,6 @@ struct AdamState {
 std::unordered_map<int, AdamState> g_states;
 std::mutex g_mu;
 
-inline uint16_t f32_to_bf16(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, sizeof(bits));
-  // round-to-nearest-even on the truncated mantissa
-  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-  return static_cast<uint16_t>((bits + rounding) >> 16);
-}
 
 // Scalar reference step for the tail (and non-SIMD builds).
 void adam_scalar(const AdamState& s, float bc1, float bc2, float lr,

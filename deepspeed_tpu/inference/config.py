@@ -58,3 +58,7 @@ class DeepSpeedInferenceConfig:
 
     def __post_init__(self):
         self.dtype = resolve_dtype(self.dtype)
+        # dtype=int8 means weight quantization, never a value-cast of float
+        # weights to int8 (reference auto-sets quantize when dtype==torch.int8).
+        if self.dtype == jnp.int8:
+            self.quantize = True

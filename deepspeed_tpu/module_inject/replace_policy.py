@@ -150,6 +150,17 @@ class HFLlamaLayerPolicy(DSPolicy):
         from ..models.llama import LlamaConfig, LlamaForCausalLM
 
         hc = hf_model.config
+        # Mistral-style sliding-window attention is not modelled by the
+        # converted LlamaConfig; silently dropping the window would make long
+        # sequences diverge from HF, so refuse when it is actually binding.
+        window = getattr(hc, "sliding_window", None)
+        if window is not None and window < hc.max_position_embeddings:
+            raise NotImplementedError(
+                f"{type(hf_model).__name__} uses sliding-window attention "
+                f"(window={window} < max_position_embeddings="
+                f"{hc.max_position_embeddings}), which the converted model "
+                "does not implement; conversion would silently diverge for "
+                "sequences longer than the window")
         cfg = LlamaConfig(
             vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
             intermediate_size=hc.intermediate_size,

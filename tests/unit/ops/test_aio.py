@@ -63,6 +63,54 @@ def test_offset_read_write(tmp_path):
     h.close()
 
 
+def test_async_ops_do_not_leak_fds(tmp_path):
+    """Every submit opens an fd; the worker finishing a submit's last sub-op
+    must close it, or long offload runs exhaust the process fd limit."""
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(block_size=4096, num_threads=2)
+    data = np.random.RandomState(0).randn(10_000).astype(np.float32)
+    path = str(tmp_path / "leak.bin")
+    h.pwrite(data, path)
+
+    def open_fds():
+        return len(os.listdir("/proc/self/fd"))
+
+    out = np.zeros_like(data)
+    for _ in range(4):  # warm any lazily-created fds (locale, /proc, etc.)
+        h.async_pread(out, path)
+        h.wait()
+    before = open_fds()
+    for _ in range(200):
+        h.async_pread(out, path)
+        h.async_pwrite(data, path)
+        h.wait()
+    assert open_fds() <= before + 2, "async aio ops leaked file descriptors"
+    h.close()
+
+
+def test_sync_error_does_not_poison_later_ops(tmp_path):
+    """A failed op must not leave a sticky error flag that makes every later
+    successful op on the handle return failure."""
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(num_threads=2)
+    path = str(tmp_path / "ok.bin")
+    data = np.arange(1000, dtype=np.float32)
+    h.pwrite(data, path)
+    # short read: ask for more bytes than the file holds → error on that op
+    big = np.zeros(2000, np.float32)
+    with pytest.raises(OSError):
+        h.pread(big, path)
+    # subsequent correct ops succeed
+    out = np.zeros_like(data)
+    h.pread(out, path)
+    np.testing.assert_array_equal(out, data)
+    h.async_pread(out, path)
+    assert h.wait() > 0
+    h.close()
+
+
 def test_read_missing_file_raises(tmp_path):
     from deepspeed_tpu.ops.aio import aio_handle
 

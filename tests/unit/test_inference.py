@@ -210,3 +210,38 @@ def test_int8_quantized_inference_close_to_fp():
     # int8 grouped quantization: argmax agreement on most positions
     agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
     assert agree > 0.7, f"int8 argmax agreement too low: {agree}"
+
+
+def test_int8_dtype_auto_enables_quantize():
+    """dtype="int8" without quantize=True must quantize, not value-cast float
+    weights to int8 garbage (ADVICE r1; reference auto-sets quantize)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    fp = ds.init_inference(model, params=params, dtype="fp32")
+    q = ds.init_inference(model, params=params, dtype="int8")  # no quantize kwarg
+    assert q.config.quantize
+    agree = (np.asarray(fp(ids)).argmax(-1) == np.asarray(q(ids)).argmax(-1)).mean()
+    assert agree > 0.7, f"int8 argmax agreement too low: {agree}"
+
+
+def test_mistral_sliding_window_rejected():
+    """A binding sliding window cannot be represented by the converted model;
+    conversion must refuse rather than silently diverge (ADVICE r1)."""
+    import types
+
+    from deepspeed_tpu.module_inject.replace_policy import HFLlamaLayerPolicy
+
+    config = types.SimpleNamespace(
+        sliding_window=128, max_position_embeddings=2048, vocab_size=256,
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, rms_norm_eps=1e-6)
+    fake = type("MistralForCausalLM", (), {})()
+    fake.config = config
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        HFLlamaLayerPolicy().convert(fake)
