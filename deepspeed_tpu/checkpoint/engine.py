@@ -21,6 +21,32 @@ from ..utils.logging import log_dist
 
 LATEST_FILE = "latest"  # reference writes the same tag file
 
+# Long-lived checkpointer singletons. Orbax checkpointers own async commit
+# machinery (thread pools / barrier futures); constructing one per save and
+# letting it be GC'd can tear that machinery down while a save is in flight
+# ("cannot schedule new futures after shutdown") and silently write nothing.
+# One instance per process, closed at exit, is the reliable pattern.
+_CKPTRS: Dict[str, Any] = {}
+
+
+def _checkpointer(kind: str):
+    if kind not in _CKPTRS:
+        import atexit
+
+        ckptr = (ocp.StandardCheckpointer() if kind == "sync"
+                 else ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()))
+        atexit.register(ckptr.close)
+        _CKPTRS[kind] = ckptr
+    return _CKPTRS[kind]
+
+
+def _sync_checkpointer():
+    return _checkpointer("sync")
+
+
+def _async_checkpointer():
+    return _checkpointer("async")
+
 
 class CheckpointEngine:
     """ABC parity (reference ``checkpoint_engine.py:1``)."""
@@ -45,12 +71,14 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     """Synchronous orbax engine (the ``TorchCheckpointEngine`` analog)."""
 
     def save(self, state_dict: Any, path: str):
-        ocp.StandardCheckpointer().save(os.path.abspath(path), state_dict, force=True)
+        ckptr = _sync_checkpointer()
+        ckptr.save(os.path.abspath(path), state_dict, force=True)
+        ckptr.wait_until_finished()
 
     def load(self, path: str, map_location=None, abstract_state: Any = None):
         if abstract_state is not None:
-            return ocp.StandardCheckpointer().restore(os.path.abspath(path), abstract_state)
-        return ocp.StandardCheckpointer().restore(os.path.abspath(path))
+            return _sync_checkpointer().restore(os.path.abspath(path), abstract_state)
+        return _sync_checkpointer().restore(os.path.abspath(path))
 
 
 class AsyncCheckpointEngine(CheckpointEngine):
@@ -59,7 +87,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def __init__(self, config_params=None):
         super().__init__(config_params)
-        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        self._ckptr = _async_checkpointer()
 
     def save(self, state_dict: Any, path: str):
         self._ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state_dict),

@@ -184,7 +184,7 @@ class PipelineEngine(DeepSpeedEngine):
 
     # ------------------------------------------------------------------
 
-    def _init_params(self, example_batch):  # pragma: no cover - not used
+    def _make_init_fn(self, example_batch):  # pragma: no cover - not used
         raise RuntimeError("PipelineEngine initializes params via PipelineModule")
 
     @staticmethod

@@ -168,13 +168,23 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self._rng = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         self.example_batch = example_batch
         params = model_parameters
+        init_fn = init_rngs = None
         if params is None and model is not None and example_batch is not None:
-            params = self._init_params(example_batch)
-        if params is None:
+            # Sharded-at-birth init (the real ``zero.Init``): derive shardings
+            # from abstract shapes first, then materialize under jit with
+            # ``out_shardings`` so no leaf is ever fully resident on one
+            # device (reference: ``partition_parameters.py:537`` exists to
+            # avoid exactly that replicated birth).
+            init_fn, init_args = self._make_init_fn(example_batch)
+            params_shapes = jax.eval_shape(init_fn, *init_args)
+        elif params is not None:
+            params = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, jnp.float32)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                else jnp.asarray(p), params)
+            params_shapes = jax.eval_shape(lambda: params)
+        else:
             raise ValueError("Provide model_parameters, or model + example_batch to init")
-        params = jax.tree_util.tree_map(
-            lambda p: jnp.asarray(p, jnp.float32)
-            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p), params)
 
         # ---- optimizer --------------------------------------------------
         self.lr_scheduler = self._build_lr_scheduler()
@@ -188,9 +198,13 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.optimizer = None if self._offload else self._build_optimizer()
 
         # ---- shardings (ZeRO policy) ------------------------------------
-        params_shapes = jax.eval_shape(lambda: params)
         self.param_shardings, shard_opt = state_shardings(
             params_shapes, mesh, self._config.zero_config, partition_rules)
+        #: True when params were materialized directly into their shards
+        #: (init under jit with out_shardings) rather than placed post-hoc.
+        self.params_born_sharded = params is None
+        if params is None:
+            params = jax.jit(init_fn, out_shardings=self.param_shardings)(*init_args)
         if self._offload:
             self.opt_shardings = ()
         else:
@@ -276,13 +290,27 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             return None
         return {"dropout": base, "gating": jax.random.fold_in(base, 1)}
 
-    def _init_params(self, example_batch):
+    def _make_init_fn(self, example_batch):
+        """Build (init_fn, args) whose output is the fp32 params tree.
+
+        Used twice: ``jax.eval_shape(init_fn, *args)`` to derive shardings
+        with zero materialization, then ``jax.jit(init_fn,
+        out_shardings=...)`` so every leaf is born sharded (real
+        ``zero.Init``; shard_map-based attention also needs the jit context).
+        The batch is a traced argument, not a closure capture — captured
+        arrays would be baked into the executable as on-device constants.
+        """
         self._rng, init_rng = jax.random.split(self._rng)
         rngs = {"params": init_rng, **self._make_rngs(jax.random.fold_in(init_rng, 7))}
-        # init under jit: shard_map-based attention (ring) requires a jit
-        # context, and sharded init avoids a replicated host copy
-        variables = jax.jit(self.module.init)(rngs, **example_batch)
-        return variables["params"] if "params" in variables else variables
+
+        def init_fn(rngs, batch):
+            variables = self.module.init(rngs, **batch)
+            params = variables["params"] if "params" in variables else variables
+            return jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+        return init_fn, (rngs, example_batch)
 
     def _build_lr_scheduler(self):
         if self.client_lr_scheduler is not None:

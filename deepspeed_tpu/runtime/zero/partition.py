@@ -215,11 +215,14 @@ def Init(mesh: Optional[Mesh] = None, config_dict_or_path=None, dtype=None, enab
 
     The reference must metaclass-patch ``nn.Module.__init__`` so params are
     scattered *at construction* (a 175B model never fits on one GPU). In JAX,
-    model construction is shape-only (``jax.eval_shape``) and materialization
-    happens inside jit with output shardings — params are *born sharded* with
-    no hook machinery. This context manager therefore only marks a region
-    (and validates a mesh exists); creation-time sharding is the default
-    behavior of ``engine.initialize``.
+    model construction is shape-only: ``engine.initialize`` derives shardings
+    from ``jax.eval_shape`` of the init function and then materializes under
+    ``jax.jit(init_fn, out_shardings=param_shardings)`` — every leaf is born
+    directly into its shards with no replicated copy and no hook machinery
+    (``engine.params_born_sharded`` records this; see
+    ``test_params_born_sharded_no_replicated_birth``). This context manager
+    therefore only marks a region (and validates a mesh exists);
+    creation-time sharding is the default behavior of ``engine.initialize``.
     """
     if enabled and mesh is None:
         from ...parallel.topology import get_mesh

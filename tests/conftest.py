@@ -21,6 +21,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache: most of the suite's wall-clock is XLA compiles
+# of the same tiny-model programs; warm runs are ~4x faster.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DS_TPU_TEST_COMPILE_CACHE",
+                                 "/tmp/deepspeed_tpu_jax_test_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 import pytest  # noqa: E402
 
 

@@ -35,7 +35,8 @@ def _engine(config_extra=None, mesh=None, seed=0):
     return engine, {"input_ids": ids, "labels": ids}
 
 
-@pytest.mark.parametrize("stage", [0, 1, 3])
+@pytest.mark.parametrize("stage", [pytest.param(0, marks=pytest.mark.slow),
+                                   pytest.param(1, marks=pytest.mark.slow), 3])
 def test_zero_checkpoint_roundtrip(tmp_path, stage):
     e1, batch = _engine({"zero_optimization": {"stage": stage}})
     for _ in range(3):
@@ -50,6 +51,7 @@ def test_zero_checkpoint_roundtrip(tmp_path, stage):
     np.testing.assert_allclose(cont2, cont1, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_checkpoint_mesh_resize_on_load(tmp_path):
     """Save under data=8/ZeRO-3, restore under data=2 x model=4 TP — the
     reference needs offline reshape tools for this (deepspeed/checkpoint/);
@@ -171,3 +173,50 @@ def test_pipeline_engine_checkpoint_roundtrip(tmp_path):
     e2.load_checkpoint(str(tmp_path), tag="ck")
     got = [float(e2.train_batch(batch=batch)) for _ in range(2)]
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_checkpoint_survives_process_kill(tmp_path):
+    """Durability: once save_checkpoint returns, the checkpoint must be
+    loadable even if the process dies immediately (no atexit cleanup).
+    Guards the data-loss failure where a GC'd orbax checkpointer silently
+    wrote nothing (round-1 regression)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+        import os
+        import numpy as np
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(remat=False)
+        model = LlamaForCausalLM(cfg)
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
+        engine, *_ = ds.initialize(
+            model=model,
+            config={{"train_batch_size": 8, "steps_per_print": 0,
+                     "zero_optimization": {{"stage": 3}},
+                     "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-2}}}}}},
+            example_batch={{"input_ids": ids[:2], "labels": ids[:2]}},
+            partition_rules=LlamaForCausalLM.partition_rules(cfg))
+        engine.train_batch(batch={{"input_ids": ids, "labels": ids}})
+        engine.save_checkpoint({str(tmp_path)!r}, tag="killck")
+        os._exit(0)  # hard exit: no atexit, no GC finalizers
+    """)
+    env = dict(os.environ)
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", script], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, f"saver process failed:\n{proc.stderr[-2000:]}"
+
+    e2, batch = _engine({"zero_optimization": {"stage": 3}}, seed=1)
+    e2.load_checkpoint(str(tmp_path), tag="killck")
+    assert e2.global_steps == 1
+    loss = float(e2.train_batch(batch=batch))
+    assert np.isfinite(loss)

@@ -85,7 +85,7 @@ def test_cached_decode_matches_full_forward(family, scan_layers):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("family", [pytest.param("gpt2", marks=pytest.mark.slow), "llama"])
 def test_injection_logits_parity(family):
     torch = pytest.importorskip("torch")
     from deepspeed_tpu.module_inject import replace_transformer_layer
@@ -193,6 +193,7 @@ def test_generate_sampling_runs_and_respects_eos():
             assert (row[hits[0]:] == 5).all()
 
 
+@pytest.mark.slow
 def test_int8_quantized_inference_close_to_fp():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel

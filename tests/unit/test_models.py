@@ -51,6 +51,7 @@ def test_llama_scan_matches_loop():
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_causality():
     """Changing a future token must not affect past logits."""
     cfg = LlamaConfig.tiny(remat=False)
@@ -105,6 +106,7 @@ def test_shift_labels_and_ce():
     np.testing.assert_allclose(float(loss), np.log(10), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_trains_with_engine():
     import deepspeed_tpu as ds
 

@@ -48,6 +48,7 @@ def _run(config, nvme_path=None, steps=6):
             for _ in range(steps)], engine
 
 
+@pytest.mark.slow
 def test_cpu_offload_matches_device_path():
     losses_dev, _ = _run(_config())
     losses_off, engine = _run(_config("cpu"))
@@ -72,6 +73,7 @@ def test_cpu_offload_with_gas():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_offload_checkpoint_roundtrip(tmp_path):
     """Masters + moments must survive save/load; training continues exactly
     (reviewed failure: stale host masters clobbering loaded params)."""

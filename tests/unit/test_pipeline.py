@@ -73,7 +73,9 @@ def _data(B=8, T=8, vocab=64, seed=0):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("stages,micro", [(2, 4), (4, 4), (4, 8)])
+@pytest.mark.parametrize("stages,micro", [pytest.param(2, 4, marks=pytest.mark.slow),
+                                          (4, 4),
+                                          pytest.param(4, 8, marks=pytest.mark.slow)])
 def test_pipeline_matches_sequential(stages, micro):
     from deepspeed_tpu.parallel import build_mesh
     from deepspeed_tpu.pipe.engine import _pipeline_loss_fn

@@ -81,6 +81,7 @@ def test_ring_attention_no_seq_axis_falls_back():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ulysses", "ring"])
 def test_llama_trains_with_sequence_parallelism(impl):
     """End-to-end: Llama on a seq=4 mesh, loss matches the seq=1 run."""
