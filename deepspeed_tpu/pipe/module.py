@@ -105,7 +105,8 @@ class PipelineModule:
 
     def __init__(self, layers: Sequence, num_stages: int, loss_fn: Callable,
                  partition_method: str = "uniform",
-                 activation_checkpoint_interval: int = 0, topology=None):
+                 activation_checkpoint_interval: int = 0, topology=None,
+                 tp_partition_rules: Optional[Sequence] = None):
         self.specs: List[LayerSpec] = [_as_spec(l) for l in layers]
         self.num_stages = int(num_stages)
         self.loss_fn = loss_fn
@@ -135,6 +136,10 @@ class PipelineModule:
         self._prefix_modules = [s.build() for s in self.prefix_specs]
         self._body_module = self.body_specs[0].build() if self.body_specs else None
         self._suffix_modules = [s.build() for s in self.suffix_specs]
+        #: tensor-parallel rules for BODY-layer params, as (regex, spec) over
+        #: the per-layer param path (e.g. (r"Dense_0/kernel", P(None, "model"))).
+        #: Stage leaves are [S, Lp, ...], so specs are prefixed ("pipe", None).
+        self.tp_partition_rules = list(tp_partition_rules or [])
 
     @staticmethod
     def _longest_run(sigs: List[str]) -> Tuple[int, int]:
@@ -276,8 +281,12 @@ class PipelineModule:
 
     def partition_rules(self):
         """Engine partition rules: stage-stacked leaves ride the ``pipe``
-        axis; ZeRO overlays further sharding on unsharded dims."""
-        return [(r"^stages/", P("pipe"))]
+        axis; per-layer TP rules shard body params over ``model`` on top
+        (pipe x TP composition); ZeRO overlays further sharding on unsharded
+        dims."""
+        rules = [(r"^stages/.*" + pat.lstrip("^"), P("pipe", None, *spec))
+                 for pat, spec in self.tp_partition_rules]
+        return rules + [(r"^stages/", P("pipe"))]
 
     def in_specs(self, params) -> Dict[str, Any]:
         """shard_map in_specs tree-prefix for the params dict."""

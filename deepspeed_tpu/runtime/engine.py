@@ -367,13 +367,17 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         gas = self.gradient_accumulation_steps
 
         def compute_loss(params, batch, rng, scale):
-            half_params = jax.tree_util.tree_map(
-                lambda p: p.astype(compute_dtype)
-                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            # loss_fns marked ``casts_params`` (pipeline) cast inside their
+            # shard_map region: casting a TP-sharded param before entering a
+            # partial-manual shard_map crashes the XLA SPMD partitioner.
+            if not getattr(loss_fn, "casts_params", False):
+                params = jax.tree_util.tree_map(
+                    lambda p: p.astype(compute_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
             if loss_fn is not None:
-                loss, aux = loss_fn(half_params, batch, rng)
+                loss, aux = loss_fn(params, batch, rng)
             else:
-                loss, aux = self._default_loss(half_params, batch, rng)
+                loss, aux = self._default_loss(params, batch, rng)
             return (loss.astype(jnp.float32) * scale, loss)
 
         grad_fn = jax.grad(compute_loss, has_aux=True)

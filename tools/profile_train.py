@@ -10,9 +10,13 @@ Usage: python tools/profile_train.py [--quick]
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bench_fn(fn, *args, steps=5, warmup=2):
