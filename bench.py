@@ -7,9 +7,14 @@ the reference's headline "ZeRO-3 >157 TFLOPs/GPU" (A100) number
 """
 
 import json
+import os
 import time
 
 import numpy as np
+
+# Persistent compilation cache: first compile over the tunneled TPU can take
+# minutes; cached reruns start in seconds.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench_cache")
 
 
 def model_flops_per_step(n_params: int, batch: int, seq: int, n_layer: int,

@@ -255,9 +255,21 @@ def init_inference(model=None, config=None, mp_size: Optional[int] = None, dtype
         model, params = replace_transformer_layer(model, policy=cfg.injection_policy)
 
     if params is None and cfg.checkpoint is not None:
-        from ..checkpoint.engine import load_pytree
+        import os
 
-        params = load_pytree(cfg.checkpoint)
+        if os.path.isdir(cfg.checkpoint) and os.path.exists(
+                os.path.join(cfg.checkpoint, "config.json")):
+            # HF checkpoint directory (single-file or sharded index layout):
+            # build the model graph AND params straight from disk, no torch
+            # module (reference load_model_with_checkpoint path)
+            from ..module_inject.replace_module import load_checkpoint_dir
+
+            model, params = load_checkpoint_dir(cfg.checkpoint,
+                                                policy=cfg.injection_policy)
+        else:
+            from ..checkpoint.engine import load_pytree
+
+            params = load_pytree(cfg.checkpoint)
     if params is None:
         raise ValueError("init_inference needs params (or checkpoint=, or an HF torch model)")
     return InferenceEngine(model, params, cfg, mesh=mesh)

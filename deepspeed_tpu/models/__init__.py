@@ -1,2 +1,4 @@
 from .gpt2 import GPT2Config, GPT2LMHeadModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM  # noqa: F401
+from .transformer import (TransformerConfig, TransformerForMaskedLM,  # noqa: F401
+                          TransformerLMHeadModel)

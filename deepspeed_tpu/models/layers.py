@@ -20,6 +20,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def resolve_remat_policy(name: str):
+    """Activation-checkpoint policy by name (shared by all models so the
+    accepted strings cannot drift between model files)."""
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat_policy {name!r}; one of {sorted(policies)}")
+    return policies[name]
+
+
 class RMSNorm(nn.Module):
     """RMS LayerNorm (Llama-style)."""
 

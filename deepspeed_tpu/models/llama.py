@@ -23,7 +23,8 @@ from jax.sharding import PartitionSpec as P
 
 from .layers import (RMSNorm, apply_rotary, cache_attention_bias, cross_entropy_loss,
                      dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
-                     rotary_embedding, shift_labels, update_kv_cache)
+                     resolve_remat_policy, rotary_embedding, shift_labels,
+                     update_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +42,14 @@ class LlamaConfig:
     attention_impl: str = "xla"  # "xla" | "flash"
     scan_layers: bool = True
     remat: bool = True
+    # activation-checkpoint policy (reference: the CONFIG knobs of
+    # ``activation_checkpointing/checkpointing.py`` trade memory for FLOPs):
+    #   "nothing"  - save nothing, recompute the whole block in backward
+    #                (max memory savings, ~1/3 extra FLOPs)
+    #   "dots"     - save matmul outputs, recompute only elementwise chains
+    #                (near-zero extra FLOPs; memory ~= no-remat for big dots)
+    #   "dots_no_batch" - save only non-batch matmuls (middle ground)
+    remat_policy: str = "nothing"
 
     @property
     def head_dim(self) -> int:
@@ -164,20 +173,21 @@ class LlamaModel(nn.Module):
                 mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
                     jnp.float32)
 
+        remat_policy = resolve_remat_policy(cfg.remat_policy)
         if cfg.scan_layers:
             block_cls = _ScanBlock
             if cfg.remat and cache is None:
                 block_cls = nn.remat(
                     _ScanBlock, static_argnums=(),
                     prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=remat_policy)
             scan = nn.scan(block_cls, variable_axes={"params": 0},
                            split_rngs={"params": True, "dropout": True},
                            length=cfg.num_hidden_layers, metadata_params={})
             (x, *_), cache = scan(cfg, name="layers")(
                 (x, cos, sin, mask, cache_index, deterministic), cache)
         else:
-            block_cls = nn.remat(LlamaBlock, prevent_cse=False) \
+            block_cls = nn.remat(LlamaBlock, prevent_cse=False, policy=remat_policy) \
                 if (cfg.remat and cache is None) else LlamaBlock
             new_cache = [] if cache is not None else None
             for i in range(cfg.num_hidden_layers):

@@ -1,0 +1,363 @@
+"""Generic transformer graphs covering the reference's injection-policy
+model families (BERT/OPT/BLOOM/GPT-NeoX/...).
+
+The reference implements ONE fused CUDA block (``DeepSpeedTransformerInference``,
+``ops/transformer/inference/transformer_inference.py:735``) parameterized per
+architecture by its policies (``module_inject/replace_policy.py:66-435``:
+pre/post-LN, rotary vs learned vs alibi positions, activation, parallel
+residual, fused-QKV layouts). This module is the TPU-native equivalent: one
+flax block covering those option axes, compiled by XLA per configuration —
+policies in ``module_inject/replace_policy.py`` map HF checkpoints onto it.
+
+Decoder configs (OPT/BLOOM/NeoX) get the same scan/remat/KV-cache machinery
+as the flagship Llama model; ``causal=False`` + ``mlm_head`` yields the BERT
+encoder with its MLM head.
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
+                     init_kv_cache, repeat_kv, resolve_remat_policy,
+                     rotary_embedding, shift_labels, update_kv_cache)
+from .layers import apply_rotary as _apply_rotary_full
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: Optional[int] = None  # GQA; None = MHA
+    max_position_embeddings: int = 2048
+    causal: bool = True
+    # positions: "learned" (BERT/OPT), "rope" (NeoX), "alibi" (BLOOM), "none"
+    pos_embedding: str = "learned"
+    pos_offset: int = 0          # OPT stores positions at index pos+2
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0      # NeoX partial rotary (first pct of head_dim)
+    activation: str = "gelu"     # "gelu" | "gelu_new" | "relu"
+    norm_eps: float = 1e-5
+    pre_layernorm: bool = True   # False = post-LN (BERT, OPT-350m)
+    parallel_residual: bool = False  # NeoX: x + attn(ln1 x) + mlp(ln2 x)
+    embedding_layernorm: bool = False  # BLOOM word_embeddings_layernorm / BERT
+    final_layernorm: bool = True
+    type_vocab_size: int = 0     # BERT token-type embeddings
+    attention_bias: bool = True
+    mlp_bias: bool = True
+    tie_word_embeddings: bool = False
+    mlm_head: bool = False       # BERT cls.predictions transform+decoder
+    attention_impl: str = "xla"
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        d = int(self.head_dim * self.rotary_pct)
+        return d - d % 2
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (geometric sequence; non-power-of-two heads get
+    the interleaved tail, the standard construction)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(n_heads).is_integer():
+        return pow2_slopes(n_heads).astype(np.float32)
+    base = 2 ** int(np.floor(np.log2(n_heads)))
+    slopes = list(pow2_slopes(base))
+    extra = pow2_slopes(2 * base)[0::2][:n_heads - base]
+    return np.asarray(slopes + list(extra), np.float32)
+
+
+def alibi_bias(n_heads: int, kv_len: int) -> jnp.ndarray:
+    """[1, H, 1, S] additive bias: slope_h * key_position. Per-row constants
+    (slope * query_position) cancel in softmax, so this single form is exact
+    for full, cached-prefill, and decode attention."""
+    slopes = jnp.asarray(alibi_slopes(n_heads))
+    return (slopes[:, None] * jnp.arange(kv_len)[None, :])[None, :, None, :]
+
+
+def _act(name: str):
+    return {
+        "gelu": lambda x: nn.gelu(x, approximate=False),
+        "gelu_new": lambda x: nn.gelu(x, approximate=True),
+        "relu": nn.relu,
+    }[name]
+
+
+def _apply_rotary_partial(x, cos, sin, rotary_dim):
+    """NeoX-style partial rotary: rotate the first ``rotary_dim`` channels."""
+    if rotary_dim >= x.shape[-1]:
+        return _apply_rotary_full(x, cos, sin)
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    return jnp.concatenate([_apply_rotary_full(rot, cos, sin), rest], axis=-1)
+
+
+class GenericAttention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None):
+        cfg = self.config
+        B, T, _ = x.shape
+        H, Hkv, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(feats, use_bias=cfg.attention_bias,
+                                             name=name, param_dtype=jnp.float32)
+        q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
+        k = dense(Hkv * D, "k_proj")(x).reshape(B, T, Hkv, D)
+        v = dense(Hkv * D, "v_proj")(x).reshape(B, T, Hkv, D)
+        if cfg.pos_embedding == "rope":
+            q = _apply_rotary_partial(q, cos, sin, cfg.rotary_dim)
+            k = _apply_rotary_partial(k, cos, sin, cfg.rotary_dim)
+        if layer_cache is not None:
+            layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
+            k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
+            v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+        else:
+            k = repeat_kv(k, H // Hkv)
+            v = repeat_kv(v, H // Hkv)
+            # encoder (causal=False) relies on bias for padding; flash path
+            # only fires for pure-causal no-bias configs
+            impl = cfg.attention_impl if bias is None else "xla"
+            out = dot_product_attention(q, k, v, bias=bias, causal=cfg.causal,
+                                        attention_impl=impl)
+        out = out.reshape(B, T, H * D)
+        return dense(cfg.hidden_size, "o_proj")(out), layer_cache
+
+
+class GenericMLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(cfg.intermediate_size, use_bias=cfg.mlp_bias, name="fc_in",
+                     param_dtype=jnp.float32)(x)
+        h = _act(cfg.activation)(h)
+        return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="fc_out",
+                        param_dtype=jnp.float32)(h)
+
+
+class TransformerBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.norm_eps, name=name,
+                                       param_dtype=jnp.float32)
+        attn = GenericAttention(cfg, name="attn")
+        mlp = GenericMLP(cfg, name="mlp")
+        if cfg.parallel_residual:
+            # NeoX: both branches read the SAME input, residual-summed once
+            a, layer_cache = attn(ln("ln_attn")(x), cos, sin, bias,
+                                  layer_cache, cache_index)
+            m = mlp(ln("ln_mlp")(x))
+            x = x + a + m
+        elif cfg.pre_layernorm:
+            a, layer_cache = attn(ln("ln_attn")(x), cos, sin, bias,
+                                  layer_cache, cache_index)
+            x = x + a
+            x = x + mlp(ln("ln_mlp")(x))
+        else:
+            # post-LN (BERT, OPT-350m)
+            a, layer_cache = attn(x, cos, sin, bias, layer_cache, cache_index)
+            x = ln("ln_attn")(x + a)
+            x = ln("ln_mlp")(x + mlp(x))
+        return x, layer_cache
+
+
+class _ScanBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, carry, layer_cache):
+        x, cos, sin, bias, cache_index = carry
+        x, layer_cache = TransformerBlock(self.config, name="block")(
+            x, cos, sin, bias, layer_cache, cache_index)
+        return (x, cos, sin, bias, cache_index), layer_cache
+
+
+class TransformerModel(nn.Module):
+    """Embeddings + block stack (+ final LN). ``cache`` switches to the
+    KV-cached decode path exactly like ``LlamaModel``."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, attention_mask=None,
+                 token_type_ids=None, deterministic=True, cache=None,
+                 cache_index=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
+                     param_dtype=jnp.float32)(input_ids)
+        if positions is None:
+            start = 0 if cache_index is None else cache_index
+            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
+        if cfg.pos_embedding == "learned":
+            wpe = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
+                           cfg.hidden_size, name="embed_positions",
+                           param_dtype=jnp.float32)
+            x = x + wpe(positions + cfg.pos_offset)
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             name="token_type_embeddings",
+                             param_dtype=jnp.float32)(token_type_ids)
+        if cfg.embedding_layernorm:
+            x = nn.LayerNorm(epsilon=cfg.norm_eps, name="embed_ln",
+                             param_dtype=jnp.float32)(x)
+
+        cos = sin = jnp.zeros((B, T, 0), x.dtype)
+        if cfg.pos_embedding == "rope":
+            cos, sin = rotary_embedding(positions, cfg.rotary_dim, cfg.rope_theta,
+                                        dtype=x.dtype)
+
+        # additive attention bias: padding (+ ALiBi). The cached path folds
+        # causality in via cache_attention_bias; the full path lets the
+        # attention core apply causality.
+        kv_len = T if cache is None else \
+            jax.tree_util.tree_leaves(cache)[0].shape[-3]
+        bias = None
+        if cache is not None:
+            key_mask = attention_mask  # [B, S] over the cache
+            bias = cache_attention_bias(T, kv_len, cache_index, key_mask=key_mask)
+            if not cfg.causal:
+                raise ValueError("KV cache requires a causal decoder config")
+        elif attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e9).astype(jnp.float32)
+        if cfg.pos_embedding == "alibi":
+            ab = alibi_bias(cfg.num_attention_heads, kv_len)
+            bias = ab if bias is None else bias + ab
+
+        if cfg.scan_layers:
+            block_cls = _ScanBlock
+            if cfg.remat and cache is None:
+                block_cls = nn.remat(_ScanBlock, prevent_cse=False,
+                                     policy=resolve_remat_policy(cfg.remat_policy))
+            scan = nn.scan(block_cls, variable_axes={"params": 0},
+                           split_rngs={"params": True},
+                           length=cfg.num_hidden_layers, metadata_params={})
+            (x, *_), cache = scan(cfg, name="layers")(
+                (x, cos, sin, bias, cache_index), cache)
+        else:
+            block_cls = nn.remat(
+                TransformerBlock, prevent_cse=False,
+                policy=resolve_remat_policy(cfg.remat_policy)) \
+                if (cfg.remat and cache is None) else TransformerBlock
+            new_cache = [] if cache is not None else None
+            for i in range(cfg.num_hidden_layers):
+                layer_cache = None if cache is None else \
+                    jax.tree_util.tree_map(lambda c: c[i], cache)
+                x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                    x, cos, sin, bias, layer_cache, cache_index)
+                if new_cache is not None:
+                    new_cache.append(layer_cache)
+            if new_cache is not None:
+                cache = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_cache)
+        if cfg.final_layernorm:
+            x = nn.LayerNorm(epsilon=cfg.norm_eps, name="final_ln",
+                             param_dtype=jnp.float32)(x)
+        return x if cache is None else (x, cache)
+
+
+class TransformerLMHeadModel(nn.Module):
+    """Causal LM head over ``TransformerModel`` (OPT/BLOOM/NeoX)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None, attention_mask=None,
+                 deterministic=True, cache=None, cache_index=None):
+        cfg = self.config
+        hidden = TransformerModel(cfg, name="model")(
+            input_ids, positions, attention_mask, None, deterministic, cache,
+            cache_index)
+        if cache is not None:
+            hidden, cache = hidden
+        if cfg.tie_word_embeddings:
+            embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
+            logits = hidden @ embed.T.astype(hidden.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                              param_dtype=jnp.float32)(hidden)
+        if cache is not None:
+            return logits, cache
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, shift_labels(labels))
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.config
+        return init_kv_cache(batch, max_len, cfg.kv_heads, cfg.head_dim,
+                             n_layers=cfg.num_hidden_layers, dtype=dtype)
+
+    @staticmethod
+    def partition_rules(config: TransformerConfig):
+        from jax.sharding import PartitionSpec as P
+
+        L = (None,) if config.scan_layers else ()
+        return [
+            (r"embed_tokens/embedding", P("model", None)),
+            (r"(q_proj|k_proj|v_proj)/kernel", P(*L, None, "model")),
+            (r"(q_proj|k_proj|v_proj)/bias", P(*L, "model")),
+            (r"o_proj/kernel", P(*L, "model", None)),
+            (r"fc_in/kernel", P(*L, None, "model")),
+            (r"fc_in/bias", P(*L, "model")),
+            (r"fc_out/kernel", P(*L, "model", None)),
+            (r"lm_head/kernel", P(None, "model")),
+        ]
+
+
+class TransformerForMaskedLM(nn.Module):
+    """BERT-style encoder + MLM head (reference policy: ``HFBertLayerPolicy``,
+    ``replace_policy.py:66``)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 positions=None, deterministic=True):
+        cfg = self.config
+        hidden = TransformerModel(cfg, name="model")(
+            input_ids, positions, attention_mask, token_type_ids, deterministic)
+        if cfg.mlm_head:
+            h = nn.Dense(cfg.hidden_size, name="mlm_dense",
+                         param_dtype=jnp.float32)(hidden)
+            h = _act(cfg.activation)(h)
+            h = nn.LayerNorm(epsilon=cfg.norm_eps, name="mlm_ln",
+                             param_dtype=jnp.float32)(h)
+        else:
+            h = hidden
+        embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
+        logits = h @ embed.T.astype(h.dtype)
+        logits = logits + self.param("mlm_bias", nn.initializers.zeros,
+                                     (cfg.vocab_size,))
+        return logits
+
+    @staticmethod
+    def partition_rules(config: TransformerConfig):
+        return TransformerLMHeadModel.partition_rules(config)

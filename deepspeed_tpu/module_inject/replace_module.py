@@ -27,7 +27,8 @@ def replace_transformer_layer(model, policy: Optional[Any] = None,
         if policy is None:
             raise ValueError(
                 f"No injection policy for {type(model).__name__}; known: "
-                "GPT2, Llama/Mistral. Pass policy= explicitly.")
+                "GPT2, Llama/Mistral, OPT, BLOOM, GPT-NeoX, BERT. "
+                "Pass policy= explicitly.")
     elif isinstance(policy, type):
         policy = policy()
     if not isinstance(policy, DSPolicy):
@@ -35,6 +36,85 @@ def replace_transformer_layer(model, policy: Optional[Any] = None,
     log_dist(f"module_inject: converting {type(model).__name__} via "
              f"{type(policy).__name__}", ranks=[0])
     return policy.convert(model, scan_layers=scan_layers)
+
+
+def _match_policy_by_config(hf_config):
+    """Policy discovery from an HF config alone (no torch module needed)."""
+    from .replace_policy import generic_policies
+
+    names = list(getattr(hf_config, "architectures", None) or [])
+    names.append(getattr(hf_config, "model_type", None))
+    for policy_cls in generic_policies:
+        if any(n in policy_cls.hf_model_types for n in names if n):
+            return policy_cls
+    return None
+
+
+def _iter_checkpoint_shards(ckpt_dir: str):
+    """Yield state-dict fragments from an HF checkpoint directory, one shard
+    at a time (sharded ``*.index.json`` layouts or single-file). NOTE: the
+    current caller still accumulates all shards before conversion (policies
+    stack per-layer leaves across shards), so peak host memory is ~one full
+    state dict; per-shard incremental conversion is future work (reference
+    ``load_model_with_checkpoint``, ``inference/engine.py:263``)."""
+    import json
+    import os
+
+    def load_file(path):
+        if path.endswith(".safetensors"):
+            from safetensors.numpy import load_file as st_load
+
+            return st_load(path)
+        import torch
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        return sd.get("state_dict", sd) if isinstance(sd, dict) else sd
+
+    for index_name in ("model.safetensors.index.json",
+                       "pytorch_model.bin.index.json"):
+        idx = os.path.join(ckpt_dir, index_name)
+        if os.path.exists(idx):
+            with open(idx) as f:
+                weight_map = json.load(f)["weight_map"]
+            for shard in sorted(set(weight_map.values())):
+                yield load_file(os.path.join(ckpt_dir, shard))
+            return
+    for single in ("model.safetensors", "pytorch_model.bin"):
+        path = os.path.join(ckpt_dir, single)
+        if os.path.exists(path):
+            yield load_file(path)
+            return
+    raise FileNotFoundError(
+        f"no model weights found in {ckpt_dir} (expected model.safetensors, "
+        "pytorch_model.bin, or a sharded *.index.json layout)")
+
+
+def load_checkpoint_dir(ckpt_dir: str, policy: Optional[Any] = None,
+                        scan_layers: bool = True) -> Tuple[Any, Any]:
+    """Convert an HF checkpoint DIRECTORY → ``(flax_module, params)`` without
+    instantiating the torch model (reference: MP-sharded checkpoint loading,
+    ``inference/engine.py:263`` + ``module_inject/load_checkpoint.py``).
+    Handles single-file and sharded (index.json) HF layouts."""
+    from .replace_policy import _to_numpy
+
+    import transformers
+
+    hf_config = transformers.AutoConfig.from_pretrained(ckpt_dir)
+    if policy is None:
+        policy = _match_policy_by_config(hf_config)
+        if policy is None:
+            raise ValueError(f"No injection policy for checkpoint {ckpt_dir} "
+                             f"(architectures={hf_config.architectures})")
+    if not isinstance(policy, type):
+        policy = type(policy)
+    if not hasattr(policy, "convert_state_dict"):
+        raise TypeError(f"{policy} does not support state-dict conversion")
+    sd = {}
+    for shard in _iter_checkpoint_shards(ckpt_dir):
+        sd.update({k: _to_numpy(v) for k, v in shard.items()})
+    log_dist(f"module_inject: loading {ckpt_dir} "
+             f"({hf_config.architectures}) via {policy.__name__}", ranks=[0])
+    return policy.convert_state_dict(hf_config, sd, scan_layers)
 
 
 def revert_transformer_layer(*args, **kwargs):

@@ -86,14 +86,17 @@ class HFGPT2LayerPolicy(DSPolicy):
     ]
 
     def convert(self, hf_model, scan_layers: bool = True):
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        return self.convert_state_dict(hf_model.config, sd, scan_layers)
+
+    @classmethod
+    def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
         from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
 
-        hc = hf_model.config
         cfg = GPT2Config(vocab_size=hc.vocab_size, n_positions=hc.n_positions,
                          n_embd=hc.n_embd, n_layer=hc.n_layer, n_head=hc.n_head,
                          layer_norm_epsilon=hc.layer_norm_epsilon,
                          scan_layers=scan_layers, remat=False)
-        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
         pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
 
         params: Dict[str, Any] = {}
@@ -107,13 +110,13 @@ class HFGPT2LayerPolicy(DSPolicy):
             return w.T if transpose else w
 
         if scan_layers:
-            for suffix, path, tr in self.LAYER_MAP:
+            for suffix, path, tr in cls.LAYER_MAP:
                 stacked = np.stack([layer_leaf(i, suffix, tr)
                                     for i in range(cfg.n_layer)])
                 _set(params, f"h/block/{path}", stacked)
         else:
             for i in range(cfg.n_layer):
-                for suffix, path, tr in self.LAYER_MAP:
+                for suffix, path, tr in cls.LAYER_MAP:
                     _set(params, f"h_{i}/{path}", layer_leaf(i, suffix, tr))
         return GPT2LMHeadModel(cfg), params
 
@@ -146,21 +149,30 @@ class HFLlamaLayerPolicy(DSPolicy):
         ("mlp.down_proj.weight", "mlp/down_proj/kernel", True),
     ]
 
-    def convert(self, hf_model, scan_layers: bool = True):
-        from ..models.llama import LlamaConfig, LlamaForCausalLM
-
-        hc = hf_model.config
+    @staticmethod
+    def _check_window(hc):
         # Mistral-style sliding-window attention is not modelled by the
         # converted LlamaConfig; silently dropping the window would make long
         # sequences diverge from HF, so refuse when it is actually binding.
         window = getattr(hc, "sliding_window", None)
         if window is not None and window < hc.max_position_embeddings:
             raise NotImplementedError(
-                f"{type(hf_model).__name__} uses sliding-window attention "
-                f"(window={window} < max_position_embeddings="
+                f"{getattr(hc, 'architectures', None)} uses sliding-window "
+                f"attention (window={window} < max_position_embeddings="
                 f"{hc.max_position_embeddings}), which the converted model "
                 "does not implement; conversion would silently diverge for "
                 "sequences longer than the window")
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        self._check_window(hf_model.config)
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        return self.convert_state_dict(hf_model.config, sd, scan_layers)
+
+    @classmethod
+    def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+        cls._check_window(hc)
         cfg = LlamaConfig(
             vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
             intermediate_size=hc.intermediate_size,
@@ -173,7 +185,6 @@ class HFLlamaLayerPolicy(DSPolicy):
             rope_theta=getattr(hc, "rope_theta", 10000.0),
             tie_word_embeddings=getattr(hc, "tie_word_embeddings", False),
             scan_layers=scan_layers, remat=False)
-        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
         pfx = "model." if any(k.startswith("model.") for k in sd) else ""
 
         params: Dict[str, Any] = {}
@@ -187,13 +198,13 @@ class HFLlamaLayerPolicy(DSPolicy):
             return w.T if transpose else w
 
         if scan_layers:
-            for suffix, path, tr in self.LAYER_MAP:
+            for suffix, path, tr in cls.LAYER_MAP:
                 stacked = np.stack([layer_leaf(i, suffix, tr)
                                     for i in range(cfg.num_hidden_layers)])
                 _set(params, f"model/layers/block/{path}", stacked)
         else:
             for i in range(cfg.num_hidden_layers):
-                for suffix, path, tr in self.LAYER_MAP:
+                for suffix, path, tr in cls.LAYER_MAP:
                     _set(params, f"model/layers_{i}/{path}", layer_leaf(i, suffix, tr))
         return LlamaForCausalLM(cfg), params
 
@@ -204,8 +215,323 @@ class HFLlamaLayerPolicy(DSPolicy):
         return LlamaForCausalLM.partition_rules(config)
 
 
+def _stack_layers(params: Dict, n_layers: int, leaf_fn, scan_layers: bool,
+                  base: str = "model/layers") -> None:
+    """Assemble per-layer leaves into the target layout: scan models stack
+    along a leading layer axis under ``{base}/block``; unrolled models get
+    ``{base}_{i}`` subtrees. ``leaf_fn(i) -> {flax_path: array}``."""
+    per_layer = [leaf_fn(i) for i in range(n_layers)]
+    if scan_layers:
+        for path in per_layer[0]:
+            _set(params, f"{base}/block/{path}",
+                 np.stack([pl[path] for pl in per_layer]))
+    else:
+        for i, pl in enumerate(per_layer):
+            for path, w in pl.items():
+                _set(params, f"{base}_{i}/{path}", w)
+
+
+def _split_fused_qkv(w, b, n_heads: int, head_dim: int):
+    """BLOOM/NeoX fused QKV: HF weight ``[3*H*D, in]`` laid out ``[H, 3, D]``
+    along the output dim → three ``[in, H*D]`` flax kernels (+ biases)."""
+    hidden_out = n_heads * head_dim
+    w = w.reshape(n_heads, 3, head_dim, -1)
+    kernels = [w[:, j].reshape(hidden_out, -1).T for j in range(3)]
+    biases = None
+    if b is not None:
+        b = b.reshape(n_heads, 3, head_dim)
+        biases = [b[:, j].reshape(hidden_out) for j in range(3)]
+    return kernels, biases
+
+
+class _GenericTransformerPolicy(DSPolicy):
+    """Shared machinery for policies targeting the generic transformer graphs
+    (``models/transformer.py``). Subclasses implement ``convert_config`` (HF
+    config → TransformerConfig) and ``layer_leaves``/``top_leaves`` (state
+    dict → flax paths). ``convert_state_dict`` works without instantiating
+    the HF torch module, which is what MP-sharded checkpoint loading uses
+    (reference ``inference/engine.py:263`` ``load_model_with_checkpoint``)."""
+
+    causal = True
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        return self.convert_state_dict(hf_model.config, sd, scan_layers)
+
+    @classmethod
+    def convert_state_dict(cls, hf_config, sd: Dict[str, np.ndarray],
+                           scan_layers: bool = True):
+        from ..models.transformer import (TransformerForMaskedLM,
+                                          TransformerLMHeadModel)
+
+        cfg = cls.convert_config(hf_config, scan_layers)
+        params: Dict[str, Any] = {}
+        cls.top_leaves(params, sd, cfg)
+        _stack_layers(params, cfg.num_hidden_layers,
+                      lambda i: cls.layer_leaves(sd, i, cfg), scan_layers)
+        model_cls = TransformerLMHeadModel if cls.causal else TransformerForMaskedLM
+        return model_cls(cfg), params
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers: bool):
+        raise NotImplementedError
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        raise NotImplementedError
+
+    @classmethod
+    def layer_leaves(cls, sd, i: int, cfg) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @staticmethod
+    def partition_rules(config):
+        from ..models.transformer import TransformerLMHeadModel
+
+        return TransformerLMHeadModel.partition_rules(config)
+
+
+class HFOPTLayerPolicy(_GenericTransformerPolicy):
+    """HF ``OPTForCausalLM`` → generic decoder (reference
+    ``replace_policy.py`` HFOPTLayerPolicy). Learned positions with the OPT
+    +2 storage offset; ReLU MLP; pre-LN except the 350m post-LN variant."""
+
+    hf_model_types = ("OPTForCausalLM", "opt", "OPTModel")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        if getattr(hc, "word_embed_proj_dim", hc.hidden_size) != hc.hidden_size:
+            raise NotImplementedError(
+                "OPT word_embed_proj_dim != hidden_size (the 350m projection "
+                "layers) is not supported")
+        act = {"relu": "relu", "gelu": "gelu"}[hc.activation_function]
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.ffn_dim, num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            pos_embedding="learned", pos_offset=2, activation=act,
+            norm_eps=1e-5, pre_layernorm=hc.do_layer_norm_before,
+            final_layernorm=hc.do_layer_norm_before,
+            tie_word_embeddings=getattr(hc, "tie_word_embeddings", True),
+            scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "model.decoder." if any(k.startswith("model.") for k in sd) \
+            else "decoder."
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}embed_tokens.weight"])
+        _set(params, "model/embed_positions/embedding",
+             sd[f"{pfx}embed_positions.weight"])
+        if cfg.final_layernorm:
+            _set(params, "model/final_ln/scale", sd[f"{pfx}final_layer_norm.weight"])
+            _set(params, "model/final_ln/bias", sd[f"{pfx}final_layer_norm.bias"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "model.decoder." if any(k.startswith("model.") for k in sd) \
+            else "decoder."
+        p = f"{pfx}layers.{i}."
+        leaves = {}
+        for hf, fx in [("self_attn.q_proj", "attn/q_proj"),
+                       ("self_attn.k_proj", "attn/k_proj"),
+                       ("self_attn.v_proj", "attn/v_proj"),
+                       ("self_attn.out_proj", "attn/o_proj"),
+                       ("fc1", "mlp/fc_in"), ("fc2", "mlp/fc_out")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+            leaves[f"{fx}/bias"] = sd[f"{p}{hf}.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}self_attn_layer_norm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}self_attn_layer_norm.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}final_layer_norm.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}final_layer_norm.bias"]
+        return leaves
+
+
+class HFBloomLayerPolicy(_GenericTransformerPolicy):
+    """HF ``BloomForCausalLM`` → generic decoder with ALiBi (reference
+    ``replace_policy.py`` BLOOMLayerPolicy). Fused QKV is stored ``[H,3,D]``
+    along the output dim — split here at conversion."""
+
+    hf_model_types = ("BloomForCausalLM", "bloom", "BloomModel")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=4 * hc.hidden_size,
+            num_hidden_layers=hc.n_layer, num_attention_heads=hc.n_head,
+            max_position_embeddings=2048, pos_embedding="alibi",
+            activation="gelu_new", norm_eps=hc.layer_norm_epsilon,
+            pre_layernorm=True, embedding_layernorm=True,
+            tie_word_embeddings=True, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}word_embeddings.weight"])
+        _set(params, "model/embed_ln/scale",
+             sd[f"{pfx}word_embeddings_layernorm.weight"])
+        _set(params, "model/embed_ln/bias",
+             sd[f"{pfx}word_embeddings_layernorm.bias"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}ln_f.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}ln_f.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        p = f"{pfx}h.{i}."
+        leaves = {}
+        (qw, kw, vw), (qb, kb, vb) = _split_fused_qkv(
+            sd[f"{p}self_attention.query_key_value.weight"],
+            sd[f"{p}self_attention.query_key_value.bias"],
+            cfg.num_attention_heads, cfg.head_dim)
+        leaves["attn/q_proj/kernel"], leaves["attn/q_proj/bias"] = qw, qb
+        leaves["attn/k_proj/kernel"], leaves["attn/k_proj/bias"] = kw, kb
+        leaves["attn/v_proj/kernel"], leaves["attn/v_proj/bias"] = vw, vb
+        leaves["attn/o_proj/kernel"] = sd[f"{p}self_attention.dense.weight"].T
+        leaves["attn/o_proj/bias"] = sd[f"{p}self_attention.dense.bias"]
+        leaves["mlp/fc_in/kernel"] = sd[f"{p}mlp.dense_h_to_4h.weight"].T
+        leaves["mlp/fc_in/bias"] = sd[f"{p}mlp.dense_h_to_4h.bias"]
+        leaves["mlp/fc_out/kernel"] = sd[f"{p}mlp.dense_4h_to_h.weight"].T
+        leaves["mlp/fc_out/bias"] = sd[f"{p}mlp.dense_4h_to_h.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}input_layernorm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}input_layernorm.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}post_attention_layernorm.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}post_attention_layernorm.bias"]
+        return leaves
+
+
+class HFGPTNeoXLayerPolicy(_GenericTransformerPolicy):
+    """HF ``GPTNeoXForCausalLM`` → generic decoder (reference
+    ``replace_policy.py`` GPTNEOXLayerPolicy): partial rotary, parallel
+    attention+MLP residual, fused ``[H,3,D]`` QKV, untied output head."""
+
+    # bare GPTNeoXModel checkpoints lack embed_out (untied head) - not convertible
+    hf_model_types = ("GPTNeoXForCausalLM", "gpt_neox")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        act = {"gelu": "gelu", "gelu_new": "gelu_new", "relu": "relu"}[hc.hidden_act]
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            pos_embedding="rope", rotary_pct=hc.rotary_pct,
+            rope_theta=getattr(hc, "rotary_emb_base", 10000.0),
+            parallel_residual=hc.use_parallel_residual, activation=act,
+            norm_eps=hc.layer_norm_eps, pre_layernorm=True,
+            tie_word_embeddings=False, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}embed_in.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}final_layer_norm.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}final_layer_norm.bias"])
+        _set(params, "lm_head/kernel", sd["embed_out.weight"].T)
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+        p = f"{pfx}layers.{i}."
+        leaves = {}
+        (qw, kw, vw), (qb, kb, vb) = _split_fused_qkv(
+            sd[f"{p}attention.query_key_value.weight"],
+            sd[f"{p}attention.query_key_value.bias"],
+            cfg.num_attention_heads, cfg.head_dim)
+        leaves["attn/q_proj/kernel"], leaves["attn/q_proj/bias"] = qw, qb
+        leaves["attn/k_proj/kernel"], leaves["attn/k_proj/bias"] = kw, kb
+        leaves["attn/v_proj/kernel"], leaves["attn/v_proj/bias"] = vw, vb
+        leaves["attn/o_proj/kernel"] = sd[f"{p}attention.dense.weight"].T
+        leaves["attn/o_proj/bias"] = sd[f"{p}attention.dense.bias"]
+        leaves["mlp/fc_in/kernel"] = sd[f"{p}mlp.dense_h_to_4h.weight"].T
+        leaves["mlp/fc_in/bias"] = sd[f"{p}mlp.dense_h_to_4h.bias"]
+        leaves["mlp/fc_out/kernel"] = sd[f"{p}mlp.dense_4h_to_h.weight"].T
+        leaves["mlp/fc_out/bias"] = sd[f"{p}mlp.dense_4h_to_h.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}input_layernorm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}input_layernorm.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}post_attention_layernorm.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}post_attention_layernorm.bias"]
+        return leaves
+
+
+class HFBertLayerPolicy(_GenericTransformerPolicy):
+    """HF ``BertForMaskedLM`` → generic post-LN encoder + MLM head
+    (reference ``replace_policy.py:66`` HFBertLayerPolicy)."""
+
+    # bare BertModel checkpoints lack the cls.predictions MLM head - not convertible
+    hf_model_types = ("BertForMaskedLM", "bert")
+    causal = False
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        act = {"gelu": "gelu", "gelu_new": "gelu_new", "relu": "relu"}[hc.hidden_act]
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            causal=False, pos_embedding="learned", activation=act,
+            norm_eps=hc.layer_norm_eps, pre_layernorm=False,
+            embedding_layernorm=True, final_layernorm=False,
+            type_vocab_size=hc.type_vocab_size, mlm_head=True,
+            tie_word_embeddings=True, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        e = f"{pfx}embeddings."
+        _set(params, "model/embed_tokens/embedding", sd[f"{e}word_embeddings.weight"])
+        _set(params, "model/embed_positions/embedding",
+             sd[f"{e}position_embeddings.weight"])
+        _set(params, "model/token_type_embeddings/embedding",
+             sd[f"{e}token_type_embeddings.weight"])
+        _set(params, "model/embed_ln/scale", sd[f"{e}LayerNorm.weight"])
+        _set(params, "model/embed_ln/bias", sd[f"{e}LayerNorm.bias"])
+        _set(params, "mlm_dense/kernel",
+             sd["cls.predictions.transform.dense.weight"].T)
+        _set(params, "mlm_dense/bias", sd["cls.predictions.transform.dense.bias"])
+        _set(params, "mlm_ln/scale", sd["cls.predictions.transform.LayerNorm.weight"])
+        _set(params, "mlm_ln/bias", sd["cls.predictions.transform.LayerNorm.bias"])
+        _set(params, "mlm_bias", sd["cls.predictions.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        p = f"{pfx}encoder.layer.{i}."
+        leaves = {}
+        for hf, fx in [("attention.self.query", "attn/q_proj"),
+                       ("attention.self.key", "attn/k_proj"),
+                       ("attention.self.value", "attn/v_proj"),
+                       ("attention.output.dense", "attn/o_proj"),
+                       ("intermediate.dense", "mlp/fc_in"),
+                       ("output.dense", "mlp/fc_out")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+            leaves[f"{fx}/bias"] = sd[f"{p}{hf}.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}attention.output.LayerNorm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}attention.output.LayerNorm.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}output.LayerNorm.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}output.LayerNorm.bias"]
+        return leaves
+
+
 #: All registered policies (reference: ``replace_policies`` list)
-generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy]
+generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy,
+                                HFOPTLayerPolicy, HFBloomLayerPolicy,
+                                HFGPTNeoXLayerPolicy, HFBertLayerPolicy]
 
 
 def match_policy(hf_model) -> Optional[DSPolicy]:

@@ -9,10 +9,11 @@ Counterpart of the reference's optimizer surface:
 TPU design: optimizers are optax ``GradientTransformation``s executed inside
 the jitted train step, where XLA already fuses the elementwise update chain
 into a handful of kernels — the explicit multi-tensor-apply machinery of the
-CUDA path is unnecessary (the whole step is one "launch"). A Pallas fused
-Adam exists in ``ops/pallas/fused_adam.py`` for the HBM-bandwidth-bound large
--model regime; ``DeepSpeedCPUAdam`` (host offload) is backed by the C++ SIMD
-module in ``csrc/``.
+CUDA path is unnecessary (the whole step is one "launch"). ``FusedAdam(...,
+pallas=True)`` swaps in the Pallas kernel (``ops/pallas/fused_adam.py``) that
+sweeps each flat buffer once — param/moment HBM bytes move exactly once per
+step — for the HBM-bandwidth-bound large-model regime; ``DeepSpeedCPUAdam``
+(host offload) is backed by the C++ SIMD module in ``csrc/``.
 """
 
 from typing import Any, Callable, Dict, Optional, Union
@@ -31,12 +32,20 @@ def _beta_pair(params: Dict[str, Any]):
 
 def FusedAdam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
               weight_decay: float = 0.0, adam_w_mode: bool = True, bias_correction: bool = True,
-              amsgrad: bool = False, **_) -> optax.GradientTransformation:
+              amsgrad: bool = False, pallas: bool = False, **_) -> optax.GradientTransformation:
     """Adam/AdamW. ``adam_w_mode`` mirrors ``fused_adam.py:15``'s switch
-    between decoupled (AdamW) and L2-regularization Adam."""
+    between decoupled (AdamW) and L2-regularization Adam. ``pallas=True``
+    routes the update through the single-sweep Pallas kernel (reference:
+    ``csrc/adam/multi_tensor_adam.cu``)."""
     if amsgrad:
         raise ValueError("FusedAdam does not support the AMSGrad variant (reference parity)")
     b1, b2 = float(betas[0]), float(betas[1])
+    if pallas:
+        from .pallas.fused_adam import scale_by_fused_adam
+
+        return scale_by_fused_adam(lr, b1=b1, b2=b2, eps=eps,
+                                   weight_decay=weight_decay,
+                                   adam_w_mode=adam_w_mode)
     if adam_w_mode:
         return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
                            nesterov=False)

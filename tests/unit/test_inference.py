@@ -246,3 +246,108 @@ def test_mistral_sliding_window_rejected():
     fake.config = config
     with pytest.raises(NotImplementedError, match="sliding-window"):
         HFLlamaLayerPolicy().convert(fake)
+
+
+# ---------------------------------------------------------------------------
+# Policy breadth: OPT / BLOOM / GPT-NeoX / BERT (VERDICT r1 missing #2)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_hf(family, seed=0):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(seed)
+    if family == "opt":
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64, dropout=0.0)
+        return transformers.OPTForCausalLM(cfg).eval()
+    if family == "bloom":
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        return transformers.BloomForCausalLM(cfg).eval()
+    if family == "gpt_neox":
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.25,
+            attention_dropout=0.0, hidden_dropout=0.0)
+        return transformers.GPTNeoXForCausalLM(cfg).eval()
+    if family == "bert":
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        return transformers.BertForMaskedLM(cfg).eval()
+    raise ValueError(family)
+
+
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "bert"])
+@pytest.mark.parametrize("scan_layers", [True, pytest.param(False, marks=pytest.mark.slow)])
+def test_generic_policy_logits_parity(family, scan_layers):
+    torch = pytest.importorskip("torch")
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+
+    hf = _tiny_hf(family)
+    model, params = replace_transformer_layer(hf, scan_layers=scan_layers)
+    ids = np.random.RandomState(1).randint(0, 100, (2, 12))
+    mask = np.ones((2, 12), np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids), attention_mask=torch.tensor(mask)).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                  attention_mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox"])
+def test_generic_decoder_generate_matches_hf_greedy(family):
+    torch = pytest.importorskip("torch")
+    import deepspeed_tpu as ds
+
+    hf = _tiny_hf(family)
+    engine = ds.init_inference(hf, dtype="fp32", mp_size=1)
+    ids = np.random.RandomState(2).randint(1, 100, (2, 8))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6, do_sample=False,
+                          pad_token_id=0).numpy()[:, 8:]
+    ours = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_load_checkpoint_dir_sharded(tmp_path):
+    """MP/size-sharded HF checkpoint directory → flax model without building
+    the torch module (reference inference/engine.py:263)."""
+    torch = pytest.importorskip("torch")
+    from deepspeed_tpu.module_inject.replace_module import load_checkpoint_dir
+
+    hf = _tiny_hf("opt")
+    # force a sharded save (multiple weight files + index.json)
+    hf.save_pretrained(tmp_path, max_shard_size="40KB", safe_serialization=False)
+    import os
+    assert any("index.json" in f for f in os.listdir(tmp_path)), \
+        "expected a sharded checkpoint for this test"
+
+    model, params = load_checkpoint_dir(str(tmp_path))
+    ids = np.random.RandomState(3).randint(0, 100, (1, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(model.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_init_inference_checkpoint_dir(tmp_path):
+    torch = pytest.importorskip("torch")
+    import deepspeed_tpu as ds
+
+    hf = _tiny_hf("gpt_neox")
+    hf.save_pretrained(tmp_path, safe_serialization=False)
+    engine = ds.init_inference(checkpoint=str(tmp_path), dtype="fp32")
+    ids = np.random.RandomState(4).randint(1, 100, (1, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=4, do_sample=False,
+                          pad_token_id=0).numpy()[:, 6:]
+    ours = np.asarray(engine.generate(ids, max_new_tokens=4, do_sample=False))
+    np.testing.assert_array_equal(ours, ref)

@@ -57,11 +57,12 @@ def main():
 
     results = []
 
-    def run_cfg(tag, remat, attention_impl, B, T, remat_policy=None, vocab=32000):
+    def run_cfg(tag, remat, attention_impl, B, T, remat_policy="nothing", vocab=32000):
         cfg = LlamaConfig(vocab_size=vocab, hidden_size=1024, intermediate_size=2816,
                           num_hidden_layers=24, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=max(T, 1024),
-                          remat=remat, attention_impl=attention_impl)
+                          remat=remat, attention_impl=attention_impl,
+                          remat_policy=remat_policy)
         model = LlamaForCausalLM(cfg)
         ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)))
         params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)["params"]
@@ -108,10 +109,13 @@ def main():
     run_cfg("baseline(remat,flash)", True, "flash", 8, 1024)
     run_cfg("no-remat,flash", False, "flash", 8, 1024)
     if not args.quick:
+        run_cfg("remat-dots,flash", True, "flash", 8, 1024, remat_policy="dots")
         run_cfg("no-remat,xla", False, "xla", 8, 1024)
         run_cfg("remat,xla", True, "xla", 8, 1024)
         run_cfg("no-remat,flash,B16", False, "flash", 16, 1024)
         run_cfg("no-remat,flash,B32", False, "flash", 32, 1024)
+        run_cfg("no-remat,xla,B32", False, "xla", 32, 1024)
+        run_cfg("remat-dots,xla,B32", True, "xla", 32, 1024, remat_policy="dots")
 
 
 if __name__ == "__main__":
