@@ -1,0 +1,134 @@
+"""Elastic training: batch-size / device-count compatibility math.
+
+Counterpart of ``deepspeed/elasticity/elasticity.py:125,:173,:287``: given a
+set of candidate micro-batch sizes and a ceiling on the global batch, find
+the global batch size that is divisible across the widest range of device
+counts — so a job can be re-scheduled at a different scale and resume with
+IDENTICAL hyperparameters (the global batch never changes, only the
+micro/gas/dp factorization).
+
+Pure math, no torch-elastic agent: on TPU the "agent" role is played by the
+launcher re-invoking ``jax.distributed`` at the new slice size; the engine
+re-reads the same elastic config and lands on the same global batch.
+"""
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"  # reference gate kept for config parity
+
+
+class ElasticityError(Exception):
+    """Base error (reference ``deepspeed/elasticity/constants.py`` family)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(micro_batch_sizes: Sequence[int],
+                              max_train_batch_size: int) -> List[int]:
+    """All global batch sizes reachable as micro * gas under the ceiling.
+
+    Using the LCM's multiples first keeps candidates divisible by every
+    micro-batch size (reference ``_get_candidate_batch_sizes``-equivalent
+    behavior: candidates must factorize over each micro batch)."""
+    import math
+
+    lcm = 1
+    for m in micro_batch_sizes:
+        lcm = lcm * m // math.gcd(lcm, m)
+    if lcm > max_train_batch_size:
+        raise ElasticityConfigError(
+            f"max_train_batch_size {max_train_batch_size} is smaller than the "
+            f"LCM {lcm} of micro_batch_sizes {list(micro_batch_sizes)}")
+    return [lcm * i for i in range(1, max_train_batch_size // lcm + 1)]
+
+
+def get_valid_gpus(batch_size: int, micro_batch_sizes: Sequence[int],
+                   min_gpus: int, max_gpus: int) -> List[int]:
+    """Device counts g for which SOME micro batch size factors the global
+    batch as ``batch = micro * gas * g`` (reference ``_get_valid_gpus``)."""
+    valid = []
+    for g in range(min_gpus, max_gpus + 1):
+        if any(batch_size % (m * g) == 0 for m in micro_batch_sizes):
+            valid.append(g)
+    return valid
+
+
+def get_best_candidates(candidate_batch_sizes: Sequence[int],
+                        micro_batch_sizes: Sequence[int], min_gpus: int,
+                        max_gpus: int, prefer_larger: bool
+                        ) -> Tuple[int, List[int]]:
+    """Pick the batch size maximizing the number of compatible device counts
+    (ties broken toward larger/smaller batch per ``prefer_larger``)."""
+    best_batch, best_gpus = -1, []
+    for b in candidate_batch_sizes:
+        gpus = get_valid_gpus(b, micro_batch_sizes, min_gpus, max_gpus)
+        better = len(gpus) > len(best_gpus) or (
+            len(gpus) == len(best_gpus) and
+            (b > best_batch if prefer_larger else 0 < b < best_batch))
+        if better:
+            best_batch, best_gpus = b, gpus
+    if best_batch < 0:
+        raise ElasticityConfigError(
+            f"no compatible global batch size for micro_batch_sizes="
+            f"{list(micro_batch_sizes)} within [{min_gpus}, {max_gpus}] devices")
+    return best_batch, best_gpus
+
+
+@dataclasses.dataclass
+class ElasticPlan:
+    final_batch_size: int
+    valid_gpus: List[int]
+    micro_batch_per_gpu: int = 0
+    gradient_accumulation_steps: int = 0
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = True
+                           ) -> ElasticPlan:
+    """Reference ``compute_elastic_config`` (``elasticity.py:287``): resolve
+    the elastic block to (final global batch, valid device counts) and — when
+    ``world_size`` is known — the micro batch + GAS for this run.
+
+    Raises ``ElasticityIncompatibleWorldSize`` if the current world size is
+    not in the compatibility set (resume at a supported scale instead)."""
+    elastic = dict(ds_config.get("elasticity", {}))
+    if not elastic.get("enabled", False):
+        raise ElasticityConfigError("elasticity block missing or disabled")
+    version = float(elastic.get("version", 0.1))
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(f"unsupported elasticity version {version}")
+    micro_batches = list(elastic.get("micro_batch_sizes", [2, 4, 6]))
+    if not micro_batches or any(m <= 0 for m in micro_batches):
+        raise ElasticityConfigError(f"bad micro_batch_sizes {micro_batches}")
+    max_batch = int(elastic.get("max_train_batch_size", 2000))
+    min_gpus = int(elastic.get("min_gpus", 1))
+    max_gpus = int(elastic.get("max_gpus", 10000))
+    prefer_larger = bool(elastic.get("prefer_larger_batch", True))
+
+    candidates = get_candidate_batch_sizes(micro_batches, max_batch)
+    final_batch, valid_gpus = get_best_candidates(
+        candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+    plan = ElasticPlan(final_batch_size=final_batch, valid_gpus=valid_gpus)
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} is not in the elastic compatibility "
+                f"set (valid counts: {valid_gpus[:16]}"
+                f"{'...' if len(valid_gpus) > 16 else ''})")
+        if return_microbatch:
+            # largest compatible micro batch -> fewest accumulation steps
+            fitting = [m for m in micro_batches
+                       if final_batch % (m * world_size) == 0]
+            mbs = max(fitting)
+            plan.micro_batch_per_gpu = mbs
+            plan.gradient_accumulation_steps = final_batch // (mbs * world_size)
+    return plan

@@ -134,16 +134,22 @@ class LlamaBlock(nn.Module):
 
 class _ScanBlock(nn.Module):
     """Carry-through wrapper so nn.scan can thread (x) while broadcasting
-    (cos, sin, mask); the per-layer KV cache rides the scan xs/ys."""
+    (cos, sin, mask); the per-layer KV cache AND the per-layer PLD gate ride
+    the scan xs/ys."""
 
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, carry, layer_cache):
+    def __call__(self, carry, xs):
+        layer_cache, pld_gate = xs
         x, cos, sin, mask, cache_index, det = carry
-        x, layer_cache = LlamaBlock(self.config, name="block")(
+        y, layer_cache = LlamaBlock(self.config, name="block")(
             x, cos, sin, mask, layer_cache, cache_index, det)
-        return (x, cos, sin, mask, cache_index, det), layer_cache
+        if pld_gate is not None:
+            # stochastic depth: gate = keep/p (inverted-dropout scaling);
+            # dropped layers pass the residual stream through unchanged
+            y = x + pld_gate * (y - x)
+        return (y, cos, sin, mask, cache_index, det), layer_cache
 
 
 class LlamaModel(nn.Module):
@@ -151,10 +157,14 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, attention_mask=None, deterministic=True,
-                 cache=None, cache_index=None):
+                 cache=None, cache_index=None, pld_theta=None):
         """``cache`` (from ``init_cache``) switches to the KV-cached decode
         path: ``attention_mask`` is then a ``[B, cache_len]`` key-padding mask
-        and the return value is ``(hidden, new_cache)``."""
+        and the return value is ``(hidden, new_cache)``.
+
+        ``pld_theta`` (traced scalar) enables progressive layer drop for this
+        step (reference ``progressive_layer_drop.py:5``): layer l keeps with
+        ``p_l = 1 - (l+1)/L * (1 - theta)``, sampled from the ``pld`` rng."""
         cfg = self.config
         B, T = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
@@ -173,6 +183,15 @@ class LlamaModel(nn.Module):
                 mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
                     jnp.float32)
 
+        # progressive layer drop: one gate per layer for this step
+        pld_gate = None
+        if pld_theta is not None and cache is None:
+            L = cfg.num_hidden_layers
+            depth = (jnp.arange(L) + 1.0) / L
+            p_keep = 1.0 - depth * (1.0 - jnp.asarray(pld_theta, jnp.float32))
+            keep = jax.random.bernoulli(self.make_rng("pld"), p_keep)
+            pld_gate = (keep.astype(x.dtype) / p_keep.astype(x.dtype))
+
         remat_policy = resolve_remat_policy(cfg.remat_policy)
         if cfg.scan_layers:
             block_cls = _ScanBlock
@@ -185,7 +204,8 @@ class LlamaModel(nn.Module):
                            split_rngs={"params": True, "dropout": True},
                            length=cfg.num_hidden_layers, metadata_params={})
             (x, *_), cache = scan(cfg, name="layers")(
-                (x, cos, sin, mask, cache_index, deterministic), cache)
+                (x, cos, sin, mask, cache_index, deterministic),
+                (cache, pld_gate))
         else:
             block_cls = nn.remat(LlamaBlock, prevent_cse=False, policy=remat_policy) \
                 if (cfg.remat and cache is None) else LlamaBlock
@@ -193,8 +213,11 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 layer_cache = None if cache is None else \
                     jax.tree_util.tree_map(lambda c: c[i], cache)
+                x_in = x
                 x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
                     x, cos, sin, mask, layer_cache, cache_index, deterministic)
+                if pld_gate is not None:
+                    x = x_in + pld_gate[i] * (x - x_in)
                 if new_cache is not None:
                     new_cache.append(layer_cache)
             if new_cache is not None:
@@ -208,10 +231,11 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, attention_mask=None,
-                 deterministic=True, cache=None, cache_index=None):
+                 deterministic=True, cache=None, cache_index=None, pld_theta=None):
         cfg = self.config
         hidden = LlamaModel(cfg, name="model")(input_ids, positions, attention_mask,
-                                               deterministic, cache, cache_index)
+                                               deterministic, cache, cache_index,
+                                               pld_theta)
         if cache is not None:
             hidden, cache = hidden
         if cfg.tie_word_embeddings:

@@ -1,0 +1,2 @@
+from .flops_profiler.profiler import (FlopsProfiler, get_model_profile,  # noqa: F401
+                                      profile_fn)

@@ -225,8 +225,34 @@ class DeepSpeedConfig:
 
         self.world_size = world_size if world_size is not None else 1
         self._initialize_params(self._param_dict)
+        self._configure_elasticity()
         self._configure_train_batch_size()
         self._do_sanity_check()
+
+    def _configure_elasticity(self) -> None:
+        """Elastic batch resolution (reference ``config.py`` elasticity hook +
+        ``elasticity.py:287``): when enabled, the GLOBAL batch comes from the
+        compatibility math, not from explicit batch keys."""
+        if not self.elasticity.enabled:
+            return
+        from ..elasticity import ElasticityConfigError, compute_elastic_config
+
+        # _auto_none-normalized: the "auto" sentinel counts as unset, matching
+        # _initialize_params
+        explicit = [k for k in ("train_batch_size",
+                                "train_micro_batch_size_per_gpu",
+                                "gradient_accumulation_steps")
+                    if _auto_none(self._param_dict.get(k)) is not None]
+        if explicit and not self.elasticity.ignore_non_elastic_batch_info:
+            raise ElasticityConfigError(
+                f"elasticity is enabled but {explicit} are set explicitly; "
+                "remove them or set elasticity.ignore_non_elastic_batch_info "
+                "(reference raises the same conflict)")
+        plan = compute_elastic_config(self._param_dict, world_size=self.world_size)
+        self.elastic_plan = plan
+        self.train_batch_size = plan.final_batch_size
+        self.train_micro_batch_size_per_gpu = plan.micro_batch_per_gpu
+        self.gradient_accumulation_steps = plan.gradient_accumulation_steps
 
     # -- parsing ----------------------------------------------------------
 

@@ -1,0 +1,74 @@
+"""Curriculum learning scheduler.
+
+Counterpart of ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8``:
+a difficulty (sequence length) schedule stepped with training. The engine
+truncates each training batch's token dimension to the current difficulty
+(reference: injects ``curriculum_seqlen`` into forward, ``engine.py:1643``).
+
+TPU note: every distinct sequence length is a distinct compiled program, so
+``difficulty_step`` should be coarse (the default rounds to multiples of 8;
+powers of two are even better) — the schedule then visits only a handful of
+shapes, each compiled once.
+"""
+
+import math
+from typing import Any, Dict
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    """``update_difficulty(step) -> int`` difficulty for this step."""
+
+    def __init__(self, config):
+        # accepts CurriculumConfig or a plain dict
+        get = (lambda k, d=None: getattr(config, k, d)) if not isinstance(config, dict) \
+            else (lambda k, d=None: config.get(k, d))
+        self.curriculum_type = get("curriculum_type", "seqlen")
+        self.min_difficulty = int(get("min_difficulty", 8))
+        self.max_difficulty = int(get("max_difficulty", 1024))
+        self.schedule_type = get("schedule_type", FIXED_LINEAR)
+        self.schedule_config: Dict[str, Any] = dict(get("schedule_config", {}) or {})
+        self.current_difficulty = self.min_difficulty
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            self.total_steps = int(self.schedule_config.get(
+                "total_curriculum_step", 1000))
+            self.difficulty_step = int(self.schedule_config.get("difficulty_step", 8))
+            if self.min_difficulty % self.difficulty_step:
+                raise ValueError("min_difficulty must be a multiple of "
+                                 "difficulty_step (compiled-shape granularity)")
+        elif self.schedule_type == FIXED_DISCRETE:
+            self.difficulties = list(self.schedule_config["difficulty"])
+            self.max_steps = list(self.schedule_config["max_step"])
+            if len(self.difficulties) != len(self.max_steps) + 1:
+                raise ValueError("fixed_discrete needs len(difficulty) == "
+                                 "len(max_step) + 1")
+        else:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type}")
+        self.root_degree = int(self.schedule_config.get("root_degree", 2))
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == FIXED_DISCRETE:
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_step < until:
+                    return int(diff)
+            return int(self.difficulties[-1])
+        frac = min(max(global_step, 0) / max(self.total_steps, 1), 1.0)
+        if self.schedule_type == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        raw = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        stepped = int(raw // self.difficulty_step) * self.difficulty_step
+        return min(max(stepped, self.min_difficulty), self.max_difficulty)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    # reference parity: state dict round-trip (checkpointed with the engine)
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

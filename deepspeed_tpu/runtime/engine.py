@@ -246,6 +246,37 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             loss_scale=jax.tree_util.tree_map(lambda _: self._replicated, loss_scale),
             skipped_steps=self._replicated)
 
+        # ---- curriculum / PLD ------------------------------------------
+        self.curriculum_scheduler = None
+        if self._config.curriculum_learning.enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_learning)
+        self._pld = None
+        if self._config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self._pld = ProgressiveLayerDrop(
+                theta=self._config.progressive_layer_drop.theta,
+                gamma=self._config.progressive_layer_drop.gamma)
+            if self.loss_fn is not None:
+                raise ValueError(
+                    "progressive_layer_drop drives the model's pld_theta "
+                    "input and requires the default model loss path")
+            if self._offload:
+                raise ValueError(
+                    "progressive_layer_drop is not supported with "
+                    "offload_optimizer (the host-optimizer grad step does "
+                    "not thread pld_theta)")
+            import inspect
+
+            sig = inspect.signature(type(self.module).__call__)
+            if "pld_theta" not in sig.parameters:
+                raise ValueError(
+                    f"progressive_layer_drop requires a model accepting "
+                    f"pld_theta; {type(self.module).__name__} does not")
+
         # ---- compiled step ---------------------------------------------
         # [gas, batch, tokens...]: batch over data axes; with sequence
         # parallelism the token dim additionally rides the seq axis
@@ -284,11 +315,13 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
     @staticmethod
     def _make_rngs(base):
-        """Per-apply rng collections: dropout + MoE gating noise (reference:
-        cuda rng tracker / gumbel sampling in sharded_moe.py)."""
+        """Per-apply rng collections: dropout + MoE gating noise + PLD layer
+        drops (reference: cuda rng tracker / gumbel sampling in
+        sharded_moe.py / progressive_layer_drop.py)."""
         if base is None:
             return None
-        return {"dropout": base, "gating": jax.random.fold_in(base, 1)}
+        return {"dropout": base, "gating": jax.random.fold_in(base, 1),
+                "pld": jax.random.fold_in(base, 2)}
 
     def _make_init_fn(self, example_batch):
         """Build (init_fn, args) whose output is the fp32 params tree.
@@ -349,9 +382,11 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
     # the compiled train step
     # ------------------------------------------------------------------
 
-    def _default_loss(self, params, batch, rng):
-        """Default loss: model returns scalar loss (HF-style) or (loss, aux)."""
-        out = self.module.apply({"params": params}, **batch,
+    def _default_loss(self, params, batch, rng, **extra):
+        """Default loss: model returns scalar loss (HF-style) or (loss, aux).
+        ``extra`` carries engine-injected model kwargs (reference: curriculum
+        seqlen / PLD state injection, ``engine.py:1636-1650``)."""
+        out = self.module.apply({"params": params}, **batch, **extra,
                                 rngs=self._make_rngs(rng))
         if isinstance(out, tuple):
             return out[0], out[1:]
@@ -365,8 +400,9 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         compute_dtype = self.compute_dtype
         fp16 = self.fp16_enabled
         gas = self.gradient_accumulation_steps
+        pld = self._pld
 
-        def compute_loss(params, batch, rng, scale):
+        def compute_loss(params, batch, rng, scale, pld_theta):
             # loss_fns marked ``casts_params`` (pipeline) cast inside their
             # shard_map region: casting a TP-sharded param before entering a
             # partial-manual shard_map crashes the XLA SPMD partitioner.
@@ -376,25 +412,32 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                     if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
             if loss_fn is not None:
                 loss, aux = loss_fn(params, batch, rng)
+            elif pld_theta is not None:
+                loss, aux = self._default_loss(params, batch, rng,
+                                               pld_theta=pld_theta)
             else:
                 loss, aux = self._default_loss(params, batch, rng)
             return (loss.astype(jnp.float32) * scale, loss)
 
         grad_fn = jax.grad(compute_loss, has_aux=True)
 
-        def microbatch_grads(params, batch, rng, scale):
-            grads, loss = grad_fn(params, batch, rng, scale)
+        def microbatch_grads(params, batch, rng, scale, pld_theta):
+            grads, loss = grad_fn(params, batch, rng, scale, pld_theta)
             return grads, loss
 
         def train_step(state: TrainState, batch, rng):
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
+            # PLD keep-rate for THIS step (reference passes pld state into
+            # forward each step, engine.py:1636)
+            pld_theta = pld.get_theta(state.step) if pld is not None else None
 
             if gas > 1:
                 rngs = jax.random.split(rng, gas)
 
                 def body(acc, xs):
                     mb, r = xs
-                    g, loss = microbatch_grads(state.params, mb, r, scale)
+                    g, loss = microbatch_grads(state.params, mb, r, scale,
+                                               pld_theta)
                     acc_g, acc_l = acc
                     return (jax.tree_util.tree_map(jnp.add, acc_g, g), acc_l + loss), None
 
@@ -406,7 +449,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 loss = sum_loss / gas
             else:
                 squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
-                grads, loss = microbatch_grads(state.params, squeezed, rng, scale)
+                grads, loss = microbatch_grads(state.params, squeezed, rng, scale,
+                                               pld_theta)
 
             # unscale
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
@@ -438,6 +482,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             )
             return new_state, loss, overflow
 
+        # raw Python step kept for the flops profiler's jaxpr walk
+        self._train_step_fn = train_step
         return jax.jit(
             train_step,
             # batch shardings follow the device_put placement from
@@ -553,6 +599,29 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             micro = [next(data_iter) for _ in range(self.gradient_accumulation_steps)]
             batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
 
+        if self.curriculum_scheduler is not None:
+            # truncate token dims to this step's difficulty (reference injects
+            # curriculum_seqlen into forward, engine.py:1643-1650; here the
+            # batch itself is cut, which is the shape XLA compiles). Distinct
+            # difficulties are distinct compiled programs — the scheduler's
+            # difficulty_step keeps that set small. Batches arrive either
+            # [train_batch, T, ...] (token axis 1) or pre-shaped
+            # [gas, micro*dp, T, ...] (token axis 2) — see _shape_batch.
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            gas = self.gradient_accumulation_steps
+
+            def cut(v):
+                lead = np.asarray(v).shape[0] if np.ndim(v) else None
+                if lead == self.train_batch_size and np.ndim(v) >= 2 \
+                        and v.shape[1] > seqlen:
+                    return v[:, :seqlen]
+                if lead == gas and lead != self.train_batch_size \
+                        and np.ndim(v) >= 3 and v.shape[2] > seqlen:
+                    return v[:, :, :seqlen]
+                return v
+
+            batch = {k: cut(v) for k, v in batch.items()}
+
         if self.wall_clock_breakdown:
             self.timers("train_batch").start()
         self.tput_timer.start()
@@ -562,7 +631,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         else:
             batch = self._shape_batch(batch)
             self._rng, step_rng = jax.random.split(self._rng)
+            fp = self._config.flops_profiler
+            profiling = (fp.enabled and self.global_steps == fp.profile_step)
+            t0 = time.perf_counter() if profiling else None
             self.state, loss, overflow = self._train_step(self.state, batch, step_rng)
+            if profiling:
+                float(loss)  # device fence so the measured latency is real
+                self._print_flops_profile(batch, step_rng,
+                                          time.perf_counter() - t0)
 
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
@@ -577,6 +653,25 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             self._report_progress(loss)
         self._last_loss = loss
         return loss
+
+    def _print_flops_profile(self, shaped_batch, rng, step_time_s):
+        """Flops-profiler hook (reference ``engine.py:1615,1634``: start at
+        ``profile_step``, print, stop)."""
+        from ..profiling.flops_profiler.profiler import FlopsProfiler
+
+        fp = self._config.flops_profiler
+        prof = FlopsProfiler(self)
+        prof.profile_step(shaped_batch, rng)
+        prof.step_time_s = step_time_s
+        out = open(fp.output_file, "w") if fp.output_file else None
+        try:
+            prof.print_model_profile(module_depth=fp.module_depth,
+                                     top_modules=fp.top_modules if not fp.detailed
+                                     else 0, file=out)
+        finally:
+            if out is not None:
+                out.close()
+        self._flops_profile = prof  # exposed for tests / callers
 
     # -- reference micro-step parity API --------------------------------
 

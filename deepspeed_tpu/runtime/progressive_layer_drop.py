@@ -1,0 +1,33 @@
+"""Progressive Layer Drop (PLD).
+
+Counterpart of ``deepspeed/runtime/progressive_layer_drop.py:5``: a keep-rate
+schedule theta(t) that anneals from 1 (keep everything early, when layers are
+most plastic) down to ``theta``; blocks are stochastically skipped with a
+depth-scaled keep probability, which both regularizes and saves compute.
+
+TPU realization: the engine evaluates theta(step) inside the compiled step
+and the model samples one Bernoulli keep decision PER LAYER per step
+(depth-scaled: layer l keeps with p_l = 1 - (l+1)/L * (1 - theta)), applying
+``x = x_in + keep/p_l * (block(x_in) - x_in)`` — inverted-dropout scaling so
+expectations match at eval. Under ``nn.scan`` the keep mask rides the scan xs,
+so the compiled program is identical across steps (no shape changes).
+"""
+
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """theta(t) = (1 - theta_min) * gamma_decay(t) + theta_min."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+
+    def get_theta(self, global_step) -> jnp.ndarray:
+        """Traced-safe: ``global_step`` may be a jnp scalar inside jit."""
+        step = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * step) + self.theta
+
+    # reference parity accessors
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.theta}
