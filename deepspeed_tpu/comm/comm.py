@@ -268,8 +268,14 @@ def init_distributed(dist_backend: str = "xla", coordinator_address: Optional[st
         return
     import os
 
-    explicit = coordinator_address is not None or "COORDINATOR_ADDRESS" in os.environ
-    if explicit or (num_processes and num_processes > 1):
+    # launcher-provided layout (launcher/launch.py exports these per process)
+    if num_processes is None and "DS_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DS_TPU_NUM_PROCESSES"])
+    if process_id is None and "DS_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DS_TPU_PROCESS_ID"])
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is not None or (num_processes and num_processes > 1):
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes, process_id=process_id)
         if verbose:

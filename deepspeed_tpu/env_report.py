@@ -35,12 +35,25 @@ def env_info():
     print(f"deepspeed_tpu version: {deepspeed_tpu.__version__}")
     print(f"python version: {sys.version.split()[0]}")
     print(f"jax version: {jax.__version__}; jaxlib: {jaxlib.__version__}")
-    try:
-        devs = jax.devices()
-        print(f"devices: {len(devs)} x {devs[0].device_kind} "
-              f"(platform {devs[0].platform})")
-    except Exception as e:  # no accelerator in this context
-        print(f"devices: unavailable ({e})")
+    # bounded device query: a wedged accelerator tunnel must not hang the
+    # report (jax.devices blocks indefinitely on some transports)
+    import threading
+
+    result = {}
+
+    def query():
+        try:
+            devs = jax.devices()
+            result["msg"] = (f"devices: {len(devs)} x {devs[0].device_kind} "
+                             f"(platform {devs[0].platform})")
+        except Exception as e:  # no accelerator in this context
+            result["msg"] = f"devices: unavailable ({e})"
+
+    t = threading.Thread(target=query, daemon=True)
+    t.start()
+    t.join(timeout=float(os.environ.get("DS_REPORT_DEVICE_TIMEOUT", "20")))
+    print(result.get("msg", "devices: query timed out (accelerator runtime "
+                            "unreachable); set JAX_PLATFORMS=cpu to skip"))
     try:
         import flax
         import optax

@@ -1,0 +1,118 @@
+"""Per-node process spawner.
+
+Counterpart of ``deepspeed/launcher/launch.py:123``: decode the world layout,
+set per-process rendezvous env, spawn one process per local worker, babysit
+them (fail fast on the first crash, SIGTERM the rest), write a pid file.
+
+Differences from the reference, by design: rendezvous is
+``jax.distributed`` (coordinator address + process id) instead of
+MASTER_ADDR/RANK NCCL env; there is no per-GPU CUDA_VISIBLE_DEVICES
+carving — a TPU process owns its host's chips via the TPU runtime, and
+CPU-mesh testing carves virtual devices via ``DS_TPU_CPU_DEVICES``.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu per-node launcher")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes to spawn on this node")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--world_size", type=int, default=0,
+                   help="total processes across nodes (0 = nnodes * "
+                        "nproc_per_node; set explicitly for heterogeneous "
+                        "slot counts)")
+    p.add_argument("--rank_offset", type=int, default=-1,
+                   help="global rank of this node's first process (-1 = "
+                        "node_rank * nproc_per_node)")
+    p.add_argument("--coordinator", default="127.0.0.1:29500",
+                   help="host:port of process 0 (jax.distributed coordinator)")
+    p.add_argument("--cpu_devices_per_proc", type=int, default=0,
+                   help="testing: give each process N virtual CPU devices "
+                        "instead of TPU chips")
+    p.add_argument("--pid_file", default=None)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def spawn_processes(args) -> List[subprocess.Popen]:
+    procs = []
+    world = args.world_size or args.nnodes * args.nproc_per_node
+    offset = args.rank_offset if args.rank_offset >= 0 \
+        else args.node_rank * args.nproc_per_node
+    for local_rank in range(args.nproc_per_node):
+        rank = offset + local_rank
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": args.coordinator,
+            "DS_TPU_NUM_PROCESSES": str(world),
+            "DS_TPU_PROCESS_ID": str(rank),
+            "DS_TPU_LOCAL_RANK": str(local_rank),
+            # reference-compat names many user scripts read:
+            "RANK": str(rank), "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world),
+        })
+        if args.cpu_devices_per_proc:
+            env["DS_TPU_CPU_DEVICES"] = str(args.cpu_devices_per_proc)
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch_worker",
+               args.script] + list(args.script_args)
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
+
+
+def monitor(procs: List[subprocess.Popen]) -> int:
+    """Fail fast: first non-zero exit kills the rest (reference launch.py
+    sigkill handler + poll loop)."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.terminate()
+        return 130
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    # argparse.REMAINDER keeps a leading "--" if present
+    if args.script_args and args.script_args[0] == "--":
+        args.script_args = args.script_args[1:]
+    procs = spawn_processes(args)
+    if args.pid_file:
+        with open(args.pid_file, "w") as f:
+            f.write("\n".join(str(p.pid) for p in procs))
+
+    def term(_sig, _frm):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, term)
+    return monitor(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Per-process shim executed by the launcher.
+
+Applies platform overrides BEFORE the user script imports anything heavy —
+needed because this sandbox (and some TPU images) pre-import jax from
+sitecustomize, so ``JAX_PLATFORMS`` env alone cannot switch platforms; the
+``jax.config`` route always works. Then hands control to the user script via
+``runpy`` (the reference's ``launch.py`` execs ``python train.py`` directly;
+the shim is the TPU twist).
+"""
+
+import os
+import runpy
+import sys
+
+
+def main():
+    if len(sys.argv) < 2:
+        print("usage: python -m deepspeed_tpu.launcher.launch_worker "
+              "<script.py> [args...]", file=sys.stderr)
+        sys.exit(2)
+    cpu_devices = os.environ.get("DS_TPU_CPU_DEVICES")
+    if cpu_devices:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", int(cpu_devices))
+    script, args = sys.argv[1], sys.argv[2:]
+    sys.argv = [script] + args
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

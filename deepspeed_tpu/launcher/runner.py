@@ -1,0 +1,195 @@
+"""Top-level job launcher (the ``deepspeed_tpu`` CLI).
+
+Counterpart of ``deepspeed/launcher/runner.py:351``: hostfile parsing,
+include/exclude filters, and a per-backend multinode runner. The reference
+reaches nodes with PDSH/OpenMPI/MVAPICH and rendezvouses NCCL; here nodes
+are reached with plain ssh (or ``gcloud compute tpus tpu-vm ssh`` for TPU
+pods) and rendezvous is ``jax.distributed`` — worker 0's address is the
+coordinator every process dials.
+
+Single node (or CPU-mesh testing) skips ssh entirely and delegates to the
+per-node spawner (``launch.py``).
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(path: str) -> Dict[str, int]:
+    """``hostname slots=N`` per line (reference ``fetch_hostfile``
+    ``runner.py:176``); comments and blanks ignored."""
+    hosts: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) == 1:
+                hosts[parts[0]] = 1
+                continue
+            name, slots = parts[0], parts[1]
+            if not slots.startswith("slots="):
+                raise ValueError(f"bad hostfile line: {line!r}")
+            hosts[name] = int(slots.split("=", 1)[1])
+    if not hosts:
+        raise ValueError(f"hostfile {path} is empty")
+    return hosts
+
+
+def filter_hosts(hosts: Dict[str, int], include: str = "",
+                 exclude: str = "") -> Dict[str, int]:
+    """``--include``/``--exclude`` of the form ``host1,host2`` or
+    ``host1:0,1@host2:2`` (reference ``parse_resource_filter``
+    ``runner.py:217``; slot lists restrict a host's process count)."""
+
+    def parse(spec: str) -> Dict[str, Optional[List[int]]]:
+        out: Dict[str, Optional[List[int]]] = {}
+        for part in filter(None, (p.strip() for p in spec.replace("@", ",").split(","))):
+            if ":" in part:
+                host, slots = part.split(":", 1)
+                out[host] = [int(s) for s in slots.split()[0].split(";") if s]
+            else:
+                out[part] = None
+        return out
+
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    result = dict(hosts)
+    if include:
+        inc = parse(include)
+        unknown = set(inc) - set(hosts)
+        if unknown:
+            raise ValueError(f"--include names unknown hosts: {sorted(unknown)}")
+        result = {h: (len(slots) if slots is not None else hosts[h])
+                  for h, slots in inc.items()}
+    elif exclude:
+        exc = parse(exclude)
+        unknown = set(exc) - set(hosts)
+        if unknown:
+            raise ValueError(f"--exclude names unknown hosts: {sorted(unknown)}")
+        for h, slots in exc.items():
+            if slots is None:
+                result.pop(h, None)
+            else:
+                result[h] = max(0, result[h] - len(slots))
+        result = {h: n for h, n in result.items() if n > 0}
+    return result
+
+
+def build_node_command(args, node_rank: int, nproc: int, nnodes: int,
+                       coordinator: str, world_size: int = 0,
+                       rank_offset: int = -1) -> List[str]:
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--nproc_per_node={nproc}", f"--nnodes={nnodes}",
+           f"--node_rank={node_rank}", f"--coordinator={coordinator}",
+           f"--world_size={world_size}", f"--rank_offset={rank_offset}"]
+    if args.cpu_devices_per_proc:
+        cmd.append(f"--cpu_devices_per_proc={args.cpu_devices_per_proc}")
+    cmd.append(args.script)
+    cmd += list(args.script_args)
+    return cmd
+
+
+class SSHRunner:
+    """Minimal PDSH-equivalent: one ssh per node, output streamed with a
+    ``[host]`` prefix, first failure tears the job down (reference
+    ``PDSHRunner`` ``multinode_runner.py:45``)."""
+
+    def __init__(self, ssh_args: str = ""):
+        self.ssh_args = shlex.split(ssh_args) if ssh_args else []
+
+    def run(self, per_node_cmds: List[Tuple[str, List[str]]], env_keys: List[str]) -> int:
+        procs = []
+        exports = [f"{k}={shlex.quote(os.environ[k])}" for k in env_keys
+                   if k in os.environ]
+        for host, cmd in per_node_cmds:
+            remote = " ".join(["cd", shlex.quote(os.getcwd()), "&&", "env"] +
+                              exports + [shlex.quote(c) for c in cmd])
+            full = ["ssh", "-o", "StrictHostKeyChecking=no", *self.ssh_args,
+                    host, remote]
+            procs.append((host, subprocess.Popen(full)))
+
+        rc = [0]
+
+        def wait(host, p):
+            r = p.wait()
+            if r != 0:
+                rc[0] = rc[0] or r
+                for _, q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+
+        threads = [threading.Thread(target=wait, args=hp) for hp in procs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return rc[0]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="deepspeed_tpu",
+        description="Launch a deepspeed_tpu training job (reference: the "
+                    "`deepspeed` CLI)")
+    p.add_argument("--hostfile", default=None,
+                   help="'host slots=N' lines; omit for single-node")
+    p.add_argument("--include", default="", help="restrict to these hosts")
+    p.add_argument("--exclude", default="", help="drop these hosts")
+    p.add_argument("--num_procs", type=int, default=None,
+                   help="processes on this node (single-node mode)")
+    p.add_argument("--coordinator_port", type=int, default=DEFAULT_COORD_PORT)
+    p.add_argument("--cpu_devices_per_proc", type=int, default=0,
+                   help="testing: virtual CPU devices per process")
+    p.add_argument("--ssh_args", default="", help="extra ssh flags")
+    p.add_argument("--env_passthrough", default="PYTHONPATH,JAX_PLATFORMS",
+                   help="comma list of env vars exported to remote nodes")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.script_args and args.script_args[0] == "--":
+        args.script_args = args.script_args[1:]
+
+    if args.hostfile is None:
+        # single-node: in-process delegation to the per-node spawner
+        from . import launch
+
+        nproc = args.num_procs or 1
+        sub = [f"--nproc_per_node={nproc}", "--nnodes=1", "--node_rank=0",
+               f"--coordinator=127.0.0.1:{args.coordinator_port}"]
+        if args.cpu_devices_per_proc:
+            sub.append(f"--cpu_devices_per_proc={args.cpu_devices_per_proc}")
+        return launch.main(sub + [args.script] + list(args.script_args))
+
+    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
+    names = list(hosts)
+    coordinator = f"{names[0]}:{args.coordinator_port}"
+    nnodes = len(names)
+    world = sum(hosts.values())
+    per_node = []
+    offset = 0
+    for rank, host in enumerate(names):
+        per_node.append((host, build_node_command(
+            args, rank, hosts[host], nnodes, coordinator,
+            world_size=world, rank_offset=offset)))
+        offset += hosts[host]
+    print(f"deepspeed_tpu: launching on {nnodes} nodes "
+          f"({sum(hosts.values())} processes), coordinator={coordinator}")
+    runner = SSHRunner(args.ssh_args)
+    return runner.run(per_node, args.env_passthrough.split(","))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

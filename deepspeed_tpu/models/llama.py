@@ -190,7 +190,10 @@ class LlamaModel(nn.Module):
             depth = (jnp.arange(L) + 1.0) / L
             p_keep = 1.0 - depth * (1.0 - jnp.asarray(pld_theta, jnp.float32))
             keep = jax.random.bernoulli(self.make_rng("pld"), p_keep)
-            pld_gate = (keep.astype(x.dtype) / p_keep.astype(x.dtype))
+            # guard p_keep -> 0 (theta=0 makes the deepest layer's p hit
+            # exactly 0; keep is then always False and 0/0 would be NaN)
+            pld_gate = jnp.where(keep, 1.0 / jnp.maximum(p_keep, 1e-6),
+                                 0.0).astype(x.dtype)
 
         remat_policy = resolve_remat_policy(cfg.remat_policy)
         if cfg.scan_layers:

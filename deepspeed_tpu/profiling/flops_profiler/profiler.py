@@ -71,8 +71,10 @@ def _conv_flops(eqn) -> Tuple[int, int]:
     dn = eqn.params["dimension_numbers"]
     k_spatial = int(np.prod([rhs.shape[i] for i in dn.rhs_spec[2:]])) \
         if hasattr(dn, "rhs_spec") else int(np.prod(rhs.shape[2:]))
+    # the kernel's in-channel dim is ALREADY in_features/feature_group_count;
+    # do not divide by fgc again
     cin = rhs.shape[dn.rhs_spec[1]] if hasattr(dn, "rhs_spec") else rhs.shape[1]
-    macs = _size(out) * cin * k_spatial // max(fgc, 1)
+    macs = _size(out) * cin * k_spatial
     return 2 * macs, macs
 
 
@@ -217,6 +219,9 @@ class FlopsProfiler:
                             file=None):
         """Reference ``print_model_profile``: tree print with per-module flops
         and share of total."""
+        if self.tree is None:
+            raise RuntimeError("no profile captured yet - call profile_step() "
+                               "(or profile_fn) before print_model_profile()")
         out = file or sys.stdout
         total = max(self.get_total_flops(), 1)
         print(f"params: {self.n_params:,}", file=out)

@@ -609,18 +609,24 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             # [gas, micro*dp, T, ...] (token axis 2) — see _shape_batch.
             seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
             gas = self.gradient_accumulation_steps
+            # only KNOWN token-axis fields are cut (a [B, num_classes] field
+            # must never be sliced); axis 1 for raw [train_batch, T] batches,
+            # axis 2 for pre-shaped [gas, micro*dp, T] batches
+            token_fields = {"input_ids", "labels", "attention_mask",
+                            "positions", "token_type_ids", "inputs"}
 
-            def cut(v):
-                lead = np.asarray(v).shape[0] if np.ndim(v) else None
-                if lead == self.train_batch_size and np.ndim(v) >= 2 \
-                        and v.shape[1] > seqlen:
+            def cut(k, v):
+                if k not in token_fields or np.ndim(v) < 2:
+                    return v
+                lead = v.shape[0]
+                if lead == self.train_batch_size and v.shape[1] > seqlen:
                     return v[:, :seqlen]
                 if lead == gas and lead != self.train_batch_size \
                         and np.ndim(v) >= 3 and v.shape[2] > seqlen:
                     return v[:, :, :seqlen]
                 return v
 
-            batch = {k: cut(v) for k, v in batch.items()}
+            batch = {k: cut(k, np.asarray(v)) for k, v in batch.items()}
 
         if self.wall_clock_breakdown:
             self.timers("train_batch").start()

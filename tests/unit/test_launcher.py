@@ -1,0 +1,100 @@
+"""Launcher tests (reference: ``tests/unit`` launcher coverage of
+``fetch_hostfile``/resource filters + the DistributedTest multi-process
+pattern, ``tests/unit/common.py:67``)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import filter_hosts, parse_hostfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(textwrap.dedent("""\
+        # pod workers
+        worker-0 slots=4
+        worker-1 slots=4
+
+        worker-2   # defaults to one slot
+        """))
+    assert parse_hostfile(str(hf)) == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+
+def test_parse_hostfile_rejects_bad_lines(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(str(hf))
+    hf.write_text("# nothing\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(str(hf))
+
+
+def test_filter_hosts_include_exclude():
+    hosts = {"a": 4, "b": 4, "c": 2}
+    assert filter_hosts(hosts, include="a,b") == {"a": 4, "b": 4}
+    assert filter_hosts(hosts, include="a:0;1") == {"a": 2}
+    assert filter_hosts(hosts, exclude="b") == {"a": 4, "c": 2}
+    assert filter_hosts(hosts, exclude="a:0;1") == {"a": 2, "b": 4, "c": 2}
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, include="a", exclude="b")
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, include="nope")
+
+
+def test_heterogeneous_rank_offsets():
+    from deepspeed_tpu.launcher.runner import build_node_command
+
+    class A:
+        cpu_devices_per_proc = 0
+        script = "t.py"
+        script_args = []
+
+    cmd = build_node_command(A(), node_rank=1, nproc=2, nnodes=3,
+                             coordinator="h0:29500", world_size=7, rank_offset=4)
+    assert "--world_size=7" in cmd and "--rank_offset=4" in cmd
+
+
+@pytest.mark.slow
+def test_cli_launches_two_process_training(tmp_path):
+    """VERDICT r1 'done' criterion: the CLI launches the engine's unit-test
+    model across 2 local processes (each with 4 virtual CPU devices) and
+    training converges under the shared 8-device mesh."""
+    script = tmp_path / "train_tiny.py"
+    script.write_text(textwrap.dedent("""\
+        import numpy as np
+        import jax
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(remat=False)
+        model = LlamaForCausalLM(cfg)
+        rs = np.random.RandomState(0)
+        batch = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)),
+                 "labels": rs.randint(0, cfg.vocab_size, (8, 16))}
+        engine, *_ = ds.initialize(model=model,
+            config={"train_batch_size": 8, "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}}},
+            example_batch={k: v[:1] for k, v in batch.items()})
+        l0 = float(engine.train_batch(batch=batch))
+        for _ in range(3):
+            loss = engine.train_batch(batch=batch)
+        assert jax.process_count() == 2 and jax.device_count() == 8
+        assert float(loss) < l0
+        print(f"OK rank {jax.process_index()}", flush=True)
+        """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--num_procs", "2", "--cpu_devices_per_proc", "4",
+         "--coordinator_port", "29731", str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("OK rank") == 2
