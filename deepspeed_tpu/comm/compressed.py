@@ -1,0 +1,104 @@
+"""Error-compensated 1-bit compressed allreduce (wire compression).
+
+Counterpart of ``deepspeed/runtime/comm/nccl.py:51``
+(``NcclBackend.compressed_allreduce``): the reference bit-packs momentum
+signs with cupy, exchanges the packed chunks with isend/irecv, decompresses
+and averages a per-rank partition, re-compresses, and allgathers — cutting
+allreduce wire volume ~32x (the entire point of 1-bit Adam).
+
+TPU-native form: the same two-phase algorithm inside ``shard_map`` over the
+data axis, with signs packed 8-per-uint8 (``jnp.packbits``) so the
+``all_to_all``/``all_gather`` move 1 bit + one fp32 scale per chunk element
+instead of 32 bits. XLA moves exactly the arrays we give it, so packing IS
+the wire format. Per-phase error feedback matches the reference (worker
+error on the local compress, server error on the reduced-chunk compress).
+
+Restriction shared with the reference: sign+mean-magnitude compression needs
+every rank to hold a same-shaped FULL tensor (momentum), i.e. pure DP
+replication of the compressed quantity.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .comm import comms_logger
+
+
+def _compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """sign+scale 1-bit compression of a [..., n] block (n % 8 == 0).
+
+    Returns (packed signs as uint8 [..., n/8], scale = mean |x| per block).
+    The decompressed value is ``sign(x) * scale`` — reference
+    ``compressed_allreduce``'s sign * norm/numel scaling."""
+    scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+    bits = (x >= 0)
+    packed = jnp.packbits(bits, axis=-1)
+    return packed, scale
+
+
+def _decompress(packed: jnp.ndarray, scale: jnp.ndarray, n: int) -> jnp.ndarray:
+    bits = jnp.unpackbits(packed, axis=-1, count=n)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0) * scale
+
+
+def compressed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray, axis_name: str = "data"):
+    """MEAN-allreduce of ``x`` over ``axis_name`` at ~1 bit per element.
+
+    Must be called INSIDE a shard_map manual region where ``axis_name`` is a
+    manual axis and ``x`` is a per-rank full tensor (1-D float32, length a
+    multiple of 8 * axis size). ``worker_error``/``server_error`` are this
+    rank's error-feedback buffers: worker_error has x's shape; server_error
+    has x.size / world elements (this rank's chunk).
+
+    Returns (allreduced mean, new_worker_error, new_server_error).
+    """
+    world = jax.lax.axis_size(axis_name)
+    n = x.shape[-1]
+    chunk = n // world
+    if n % (world * 8):
+        raise ValueError(f"compressed_allreduce needs size % (world*8) == 0, "
+                         f"got {n} on {world} ranks")
+
+    # ---- phase 1: worker compress + chunk exchange ----------------------
+    comp_in = x + worker_error
+    chunks = comp_in.reshape(world, chunk)
+    packed, scales = _compress(chunks)              # [W, chunk/8], [W, 1]
+    new_worker_error = comp_in - _decompress(packed, scales, chunk).reshape(n)
+    # all_to_all: rank r receives chunk r from every rank (wire: n/8 bytes
+    # + W scales, vs n*4 bytes uncompressed)
+    recv_packed = jax.lax.all_to_all(packed[:, None], axis_name, split_axis=0,
+                                     concat_axis=0, tiled=False)[:, 0]
+    recv_scales = jax.lax.all_to_all(scales[:, None], axis_name, split_axis=0,
+                                     concat_axis=0, tiled=False)[:, 0]
+    # decompress W workers' copies of MY chunk and average
+    my_chunk = jnp.mean(_decompress(recv_packed, recv_scales, chunk), axis=0)
+
+    # ---- phase 2: server compress + allgather ---------------------------
+    comp2_in = my_chunk + server_error
+    packed2, scale2 = _compress(comp2_in[None, :])
+    new_server_error = comp2_in - _decompress(packed2, scale2, chunk)[0]
+    all_packed = jax.lax.all_gather(packed2[0], axis_name)      # [W, chunk/8]
+    all_scales = jax.lax.all_gather(scale2[0], axis_name)       # [W, 1]
+    result = _decompress(all_packed, all_scales, chunk).reshape(n)
+
+    comms_logger.append("compressed_allreduce",
+                        int(n // 8 + world * 4 + n // 8 + world * 4), axis_name)
+    return result, new_worker_error, new_server_error
+
+
+def plain_mean_allreduce(x: jnp.ndarray, axis_name: str = "data") -> jnp.ndarray:
+    """Uncompressed baseline with the same comms accounting, for volume
+    comparison in the logger (reference logs both phases of training)."""
+    comms_logger.append("allreduce", int(x.size * x.dtype.itemsize), axis_name)
+    return jax.lax.pmean(x, axis_name)
+
+
+def pad_to_compressible(n: int, world: int) -> int:
+    """Smallest length >= n divisible by world*8 (callers pad flat buffers)."""
+    q = world * 8
+    return ((n + q - 1) // q) * q

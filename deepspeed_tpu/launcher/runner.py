@@ -51,11 +51,15 @@ def filter_hosts(hosts: Dict[str, int], include: str = "",
     ``runner.py:217``; slot lists restrict a host's process count)."""
 
     def parse(spec: str) -> Dict[str, Optional[List[int]]]:
+        # '@' separates hosts when slot lists are present (reference syntax
+        # "host1:0,1@host2:2"); plain comma lists name whole hosts
         out: Dict[str, Optional[List[int]]] = {}
-        for part in filter(None, (p.strip() for p in spec.replace("@", ",").split(","))):
+        segments = spec.split("@") if "@" in spec or ":" in spec \
+            else spec.split(",")
+        for part in filter(None, (p.strip() for p in segments)):
             if ":" in part:
                 host, slots = part.split(":", 1)
-                out[host] = [int(s) for s in slots.split()[0].split(";") if s]
+                out[host] = [int(s) for s in slots.replace(";", ",").split(",") if s]
             else:
                 out[part] = None
         return out
@@ -118,11 +122,23 @@ class SSHRunner:
             procs.append((host, subprocess.Popen(full)))
 
         rc = [0]
+        hosts = [h for h, _ in per_node_cmds]
+
+        def kill_remotes():
+            # terminating the local ssh client does NOT signal the remote
+            # process tree (no tty); best-effort remote cleanup so surviving
+            # workers don't hold the coordinator port / chips
+            for h in hosts:
+                subprocess.Popen(
+                    ["ssh", "-o", "StrictHostKeyChecking=no", *self.ssh_args, h,
+                     "pkill -f deepspeed_tpu.launcher.launch || true"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
         def wait(host, p):
             r = p.wait()
             if r != 0:
                 rc[0] = rc[0] or r
+                kill_remotes()
                 for _, q in procs:
                     if q.poll() is None:
                         q.terminate()

@@ -195,7 +195,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             raise ValueError("offload_optimizer currently supports bf16/fp32 "
                              "(use bf16 on TPU; fp16 loss scaling is a "
                              "device-side path)")
-        self.optimizer = None if self._offload else self._build_optimizer()
+        opt_cfg = self._config.optimizer
+        #: explicit wire-compressed 1-bit path (runtime/onebit_engine.py)
+        self._onebit_wire = bool(
+            opt_cfg is not None and not self._offload
+            and opt_cfg.type.lower() in ("onebitadam",)
+            and (opt_cfg.params or {}).get("comm_backend_name") == "compressed")
+        self.optimizer = None if (self._offload or self._onebit_wire) \
+            else self._build_optimizer()
 
         # ---- shardings (ZeRO policy) ------------------------------------
         self.param_shardings, shard_opt = state_shardings(
@@ -205,7 +212,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.params_born_sharded = params is None
         if params is None:
             params = jax.jit(init_fn, out_shardings=self.param_shardings)(*init_args)
-        if self._offload:
+        if self._offload or self._onebit_wire:
             self.opt_shardings = ()
         else:
             opt_shapes = jax.eval_shape(self.optimizer.init, params_shapes)
@@ -231,6 +238,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                     if jnp.issubdtype(p.dtype, jnp.floating) else p, s),
                 params, self.param_shardings)
             opt_state = ()
+        elif self._onebit_wire:
+            self._host_opt = None
+            params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
+            opt_state = ()  # built by build_onebit_wire below (needs params)
         else:
             self._host_opt = None
             params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
@@ -253,6 +264,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_learning)
+        self._moq = None
+        if self._config.quantize_training.enabled:
+            from .quantize import Quantizer
+
+            if self._offload:
+                raise ValueError("quantize_training requires the fused device "
+                                 "step (not offload_optimizer)")
+            self._moq = Quantizer(self._config.quantize_training)
         self._pld = None
         if self._config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
@@ -287,6 +306,30 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         if self._offload:
             self._train_step = None
             self._grad_step = self._compile_grad_step()
+        elif self._onebit_wire:
+            from .onebit_engine import build_onebit_wire
+
+            if self._moq is not None or self._pld is not None:
+                raise ValueError(
+                    "compressed 1-bit training does not compose with "
+                    "quantize_training (MoQ) or progressive_layer_drop; "
+                    "disable those blocks or use the optax 1-bit optimizers "
+                    "(no comm_backend_name)")
+
+            opt_state, ob_shardings, step_fn = build_onebit_wire(
+                self, dict(opt_cfg.params or {}))
+            self.opt_shardings = ob_shardings
+            self.state = self.state.replace(opt_state=jax.device_put(
+                opt_state, ob_shardings))
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=ob_shardings)
+            self._train_step_fn = step_fn
+            self._train_step = jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, None, self._replicated),
+                out_shardings=(self.state_shardings, self._replicated,
+                               self._replicated),
+                donate_argnums=(0,))
         else:
             self._train_step = self._compile_train_step()
         self._eval_step = None
@@ -401,8 +444,9 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         fp16 = self.fp16_enabled
         gas = self.gradient_accumulation_steps
         pld = self._pld
+        moq = self._moq
 
-        def compute_loss(params, batch, rng, scale, pld_theta):
+        def compute_loss(params, batch, rng, scale, pld_theta, moq_step=None):
             # loss_fns marked ``casts_params`` (pipeline) cast inside their
             # shard_map region: casting a TP-sharded param before entering a
             # partial-manual shard_map crashes the XLA SPMD partitioner.
@@ -410,6 +454,11 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 params = jax.tree_util.tree_map(
                     lambda p: p.astype(compute_dtype)
                     if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            if moq is not None and moq_step is not None:
+                # MoQ: the COMPUTE weights are fake-quantized on the
+                # progressive schedule; fp32 masters stay full precision
+                # (reference runtime/quantize.py quantizes the fp16 copies)
+                params = moq.quantize_tree(params, moq_step, rng)
             if loss_fn is not None:
                 loss, aux = loss_fn(params, batch, rng)
             elif pld_theta is not None:
@@ -421,8 +470,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
         grad_fn = jax.grad(compute_loss, has_aux=True)
 
-        def microbatch_grads(params, batch, rng, scale, pld_theta):
-            grads, loss = grad_fn(params, batch, rng, scale, pld_theta)
+        def microbatch_grads(params, batch, rng, scale, pld_theta, moq_step):
+            grads, loss = grad_fn(params, batch, rng, scale, pld_theta, moq_step)
             return grads, loss
 
         def train_step(state: TrainState, batch, rng):
@@ -430,6 +479,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             # PLD keep-rate for THIS step (reference passes pld state into
             # forward each step, engine.py:1636)
             pld_theta = pld.get_theta(state.step) if pld is not None else None
+            moq_step = state.step if moq is not None else None
 
             if gas > 1:
                 rngs = jax.random.split(rng, gas)
@@ -437,7 +487,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 def body(acc, xs):
                     mb, r = xs
                     g, loss = microbatch_grads(state.params, mb, r, scale,
-                                               pld_theta)
+                                               pld_theta, moq_step)
                     acc_g, acc_l = acc
                     return (jax.tree_util.tree_map(jnp.add, acc_g, g), acc_l + loss), None
 
@@ -450,7 +500,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             else:
                 squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
                 grads, loss = microbatch_grads(state.params, squeezed, rng, scale,
-                                               pld_theta)
+                                               pld_theta, moq_step)
 
             # unscale
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)

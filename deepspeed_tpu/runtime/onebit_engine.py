@@ -1,0 +1,165 @@
+"""Wire-compressed 1-bit Adam training step.
+
+Counterpart of the reference 1-bit optimizers' COMMUNICATION path
+(``runtime/fp16/onebit/adam.py:10`` + ``runtime/comm/nccl.py:51``): during
+warmup, gradients are mean-allreduced in full precision and Adam's variance
+adapts; after ``freeze_step`` the variance freezes and each rank updates a
+LOCAL momentum from LOCAL (unreduced) gradients, which is then exchanged via
+the error-compensated 1-bit ``compressed_allreduce`` — the collective that
+actually cuts wire volume ~32x.
+
+Engine activation: ``optimizer.type: "OnebitAdam"`` with
+``params.comm_backend_name: "compressed"``. Unlike the optax 1-bit variants
+(``ops/onebit.py``, which keep the reference's *semantics* inside XLA's
+implicit grad psum), this path makes the gradient exchange EXPLICIT: the
+whole train step runs in a shard_map manual region over the batch axes, so
+the compressed arrays are literally what crosses the interconnect.
+
+Restrictions (reference has the same shape): pure data parallelism —
+ZeRO stage 0, no model/seq axes, gas=1, bf16/fp32 (no loss scaling).
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.compressed import (compressed_allreduce, pad_to_compressible,
+                               plain_mean_allreduce)
+
+
+class OneBitWireState(NamedTuple):
+    """Flat-buffer optimizer state. ``worker_error``/``server_error`` are
+    PER-RANK (sharded over the batch axes); mu/nu are replicated."""
+
+    mu: jnp.ndarray            # [n_pad] momentum (replicated)
+    nu: jnp.ndarray            # [n_pad] variance (replicated, frozen after warmup)
+    worker_error: jnp.ndarray  # [world, n_pad] error feedback, sharded axis 0
+    server_error: jnp.ndarray  # [world, chunk] error feedback, sharded axis 0
+
+
+def _flatten_spec(params):
+    flat, unravel = ravel_pytree(params)
+    return flat.size, unravel
+
+
+def build_onebit_wire(engine, opt_params: dict):
+    """Returns (initial_opt_state, opt_shardings, train_step_fn).
+
+    ``train_step_fn(state, batch, rng) -> (state, loss, overflow)`` matches
+    the engine's compiled-step contract.
+    """
+    mesh = engine.mesh
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if shape.get("model", 1) != 1 or shape.get("seq", 1) != 1 or \
+            shape.get("pipe", 1) != 1:
+        raise ValueError("compressed 1-bit training is pure-DP: model/seq/"
+                         "pipe mesh axes must be 1 (reference restriction)")
+    if engine._config.zero_optimization_stage != 0:
+        raise ValueError("compressed 1-bit training requires ZeRO stage 0 "
+                         "(params replicated; the compressed quantity is the "
+                         "full momentum)")
+    if engine.gradient_accumulation_steps != 1:
+        raise ValueError("compressed 1-bit training supports gas=1")
+    if engine.fp16_enabled:
+        raise ValueError("use bf16/fp32 with compressed 1-bit training")
+
+    axes = tuple(a for a in ("data", "expert") if shape.get(a, 1) > 1) or ("data",)
+    world = int(np.prod([shape.get(a, 1) for a in axes]))
+
+    b1, b2 = map(float, opt_params.get("betas", (0.9, 0.999)))
+    eps = float(opt_params.get("eps", 1e-8))
+    # engine-built lr schedule wins over the raw config float
+    lr = engine.lr_scheduler if engine.lr_scheduler is not None \
+        else opt_params.get("lr", 1e-3)
+    weight_decay = float(opt_params.get("weight_decay", 0.0))
+    freeze_step = int(opt_params.get("freeze_step", 100000))
+
+    params0 = engine.state.params
+    n, unravel = _flatten_spec(params0)
+    n_pad = pad_to_compressible(n, world)
+    chunk = n_pad // world
+
+    opt_state = OneBitWireState(
+        mu=jnp.zeros((n_pad,), jnp.float32),
+        nu=jnp.zeros((n_pad,), jnp.float32),
+        worker_error=jnp.zeros((world, n_pad), jnp.float32),
+        server_error=jnp.zeros((world, chunk), jnp.float32))
+    repl = NamedSharding(mesh, P())
+    shard0 = NamedSharding(mesh, P(axes))
+    opt_shardings = OneBitWireState(mu=repl, nu=repl, worker_error=shard0,
+                                    server_error=shard0)
+
+    compute_dtype = engine.compute_dtype
+    loss_fn = engine.loss_fn
+    axis_tuple = axes if len(axes) > 1 else axes[0]
+
+    def local_loss(params, batch, rng):
+        half = jax.tree_util.tree_map(
+            lambda p: p.astype(compute_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        if loss_fn is not None:
+            loss, _ = loss_fn(half, batch, rng)
+        else:
+            loss, _ = engine._default_loss(half, batch, rng)
+        return loss.astype(jnp.float32)
+
+    def spmd(params, mu, nu, werr, serr, count, batch, rng):
+        # per-rank: lose the leading sharded axis of the error buffers
+        werr, serr = werr[0], serr[0]
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_tuple))
+        loss_local, g = jax.value_and_grad(local_loss)(params, batch, rng)
+        loss = jax.lax.pmean(loss_local, axis_tuple)
+        flat_g = jnp.pad(ravel_pytree(g)[0], (0, n_pad - n))
+
+        in_warmup = count <= freeze_step
+
+        def warmup(_):
+            g_avg = plain_mean_allreduce(flat_g, axis_tuple)
+            mu2 = b1 * mu + (1 - b1) * g_avg
+            nu2 = b2 * nu + (1 - b2) * g_avg * g_avg
+            return mu2, nu2, werr, serr
+
+        def compressed(_):
+            mu_local = b1 * mu + (1 - b1) * flat_g
+            mu_global, werr2, serr2 = compressed_allreduce(
+                mu_local, werr, serr, axis_tuple)
+            return mu_global, nu, werr2, serr2
+
+        mu2, nu2, werr2, serr2 = jax.lax.cond(in_warmup, warmup, compressed,
+                                              operand=None)
+
+        # bias-corrected Adam step on the flat buffer (variance correction
+        # freezes with the variance, reference onebit/adam.py)
+        t = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** jnp.minimum(t, float(freeze_step))
+        lr_t = jnp.asarray(lr(count) if callable(lr) else lr, jnp.float32)
+        flat_p = ravel_pytree(params)[0]
+        flat_p_pad = jnp.pad(flat_p, (0, n_pad - n))
+        upd = mu2 / bc1 / (jnp.sqrt(nu2 / bc2) + eps)
+        new_flat = flat_p_pad - lr_t * (upd + weight_decay * flat_p_pad)
+        new_params = unravel(new_flat[:n])
+        return (new_params, mu2, nu2, werr2[None], serr2[None], loss)
+
+    def train_step(state, batch, rng):
+        count = state.step + 1
+        mu, nu, werr, serr = state.opt_state
+        squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
+        fn = jax.shard_map(
+            spmd, mesh=mesh, axis_names=frozenset(axes),
+            in_specs=(P(), P(), P(), P(axes), P(axes), P(),
+                      P(axis_tuple), P()),
+            out_specs=(P(), P(), P(), P(axes), P(axes), P()),
+            check_vma=False)
+        new_params, mu2, nu2, werr2, serr2, loss = fn(
+            state.params, mu, nu, werr, serr, count, squeezed, rng)
+        new_state = state.replace(
+            step=count, params=new_params,
+            opt_state=OneBitWireState(mu2, nu2, werr2, serr2))
+        return new_state, loss, jnp.bool_(False)
+
+    return opt_state, opt_shardings, train_step
