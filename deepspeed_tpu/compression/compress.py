@@ -1,0 +1,192 @@
+"""Compression runtime: layer-targeted pruning/quantization stepped during
+training.
+
+Counterpart of ``deepspeed/compression/compress.py:97`` (``init_compression``:
+walks the model replacing matched layers with compressible variants) and
+``compression/scheduler.py:7`` (``compression_scheduler`` stepped from the
+engine at ``engine.py:1620,1943``). TPU-functional form: instead of swapping
+``nn.Module`` classes, compression is a pure transform over the param pytree
+applied INSIDE the compiled train step — each enabled method contributes a
+mask/fake-quant on the compute-dtype weights (straight-through gradients), so
+training is compression-aware while fp32 masters stay exact. The schedule is
+traced arithmetic on the step counter (one executable covers the ramp).
+
+Supported method groups (reference ``config.py`` schema):
+- ``weight_quantization``  — grouped fake-quant at target bits
+- ``sparse_pruning``       — unstructured magnitude pruning to a ratio
+- ``row_pruning``          — structured: lowest-L2 output rows zeroed
+- ``head_pruning``         — structured over attention heads (requires
+  ``num_heads``in the method params; applies to kernels whose output dim is
+  divisible by it)
+
+Each group: ``{"shared_parameters": {...schedule...}, "different_groups":
+{name: {"params": {...}, "modules": [patterns...]}}}`` — the reference's
+layout.
+"""
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Method:
+    kind: str                  # quantize | sparse | row | head
+    modules: List[str]         # regex patterns over param paths
+    params: Dict[str, Any]
+    offset: int = 0            # schedule_offset
+    end: int = 0               # schedule_offset_end (ratio ramps offset->end)
+
+
+def _ratio_at(step, offset: int, end: int, target: float):
+    """Ramp 0 → target between offset and end (end<=offset: step function)."""
+    step = jnp.asarray(step, jnp.float32)
+    if end <= offset:
+        return jnp.where(step >= offset, target, 0.0)
+    frac = jnp.clip((step - offset) / float(end - offset), 0.0, 1.0)
+    return target * frac
+
+
+def _sparse_mask(w, ratio):
+    """Keep the largest-|w| (1-ratio) fraction (traced ratio)."""
+    flat = jnp.abs(w.astype(jnp.float32)).ravel()
+    thresh = jnp.quantile(flat, ratio)
+    return (jnp.abs(w) > thresh) | (ratio <= 0.0)
+
+
+def _row_mask(w, ratio):
+    """Zero the lowest-L2 fraction of OUTPUT rows (last dim = out features)."""
+    norms = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2,
+                             axis=tuple(range(w.ndim - 1))))
+    thresh = jnp.quantile(norms, ratio)
+    keep = (norms > thresh) | (ratio <= 0.0)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def _head_mask(w, ratio, num_heads: int):
+    """Zero whole attention heads (output dim split into heads) by L2."""
+    out = w.shape[-1]
+    if out % num_heads:
+        return jnp.ones_like(w, bool)
+    hd = out // num_heads
+    wh = w.reshape(w.shape[:-1] + (num_heads, hd)).astype(jnp.float32)
+    norms = jnp.sqrt(jnp.sum(wh ** 2, axis=tuple(range(w.ndim - 1)) + (-1,)))
+    thresh = jnp.quantile(norms, ratio)
+    keep = (norms > thresh) | (ratio <= 0.0)          # [num_heads]
+    mask = jnp.repeat(keep, hd)
+    return jnp.broadcast_to(mask, w.shape)
+
+
+class CompressionScheduler:
+    """Applies every configured method to matching weight leaves at the
+    current step's intensity (reference ``compression_scheduler`` +
+    compressed-module forward)."""
+
+    def __init__(self, compression_config: Dict):
+        self.methods: List[_Method] = []
+        cfgs = {
+            "weight_quantization": "quantize",
+            "sparse_pruning": "sparse",
+            "row_pruning": "row",
+            "head_pruning": "head",
+        }
+        for block_name, kind in cfgs.items():
+            block = (compression_config or {}).get(block_name)
+            if not block:
+                continue
+            shared = block.get("shared_parameters", {})
+            if shared.get("enabled", True) is False:
+                continue
+            offset = int(shared.get("schedule_offset", 0))
+            end = int(shared.get("schedule_offset_end", offset))
+            for gname, group in (block.get("different_groups") or {}).items():
+                # shared values are DEFAULTS; per-group params override them
+                gp = {k: v for k, v in shared.items()
+                      if k not in ("schedule_offset", "schedule_offset_end",
+                                   "enabled")}
+                gp.update(group.get("params", {}))
+                if kind == "head" and int(gp.get("num_heads", 0)) < 2:
+                    raise ValueError(
+                        f"head_pruning group {gname!r} needs num_heads >= 2 "
+                        "(with num_heads=1 the whole tensor would be zeroed)")
+                self.methods.append(_Method(
+                    kind=kind, modules=list(group.get("modules", [".*"])),
+                    params=gp, offset=offset, end=end))
+        if not self.methods:
+            raise ValueError("compression_training config enables nothing")
+
+    def _matches(self, method: _Method, path: str) -> bool:
+        return any(re.search(pat, path) for pat in method.modules)
+
+    def apply(self, params: Any, step, ste: bool = True) -> Any:
+        """Transform the param tree for this step. Called inside the compiled
+        train step on the compute-dtype weights."""
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        treedef = jax.tree_util.tree_structure(params)
+
+        def one(kp, p):
+            path = "/".join(str(getattr(k, "key", k)) for k in kp)
+            if not hasattr(p, "ndim") or p.ndim < 2 or \
+                    not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            out = p
+            for m in self.methods:
+                if not self._matches(m, path):
+                    continue
+                if m.kind == "quantize":
+                    from ..runtime.quantize import quantize_dequantize
+
+                    bits = jnp.asarray(
+                        float(m.params.get("target_bits",
+                                           m.params.get("quantize_bits", 8))))
+                    groups = int(m.params.get("quantization_groups", 1))
+                    if out.size % max(groups, 1):
+                        groups = 1
+                    q = quantize_dequantize(
+                        out, bits, groups,
+                        symmetric=(m.params.get("quantization_type",
+                                                "symmetric") == "symmetric"))
+                    gate = _ratio_at(step, m.offset, m.end, 1.0)
+                    q = jnp.where(gate > 0, q, out)
+                elif m.kind == "sparse":
+                    # dense_ratio / dense_ratio_target = fraction KEPT
+                    # (reference SPARSE_PRUNING_DENSE_RATIO semantics)
+                    kept = float(m.params.get("dense_ratio_target",
+                                              m.params.get("dense_ratio", 0.5)))
+                    ratio = _ratio_at(step, m.offset, m.end, 1.0 - kept)
+                    q = out * _sparse_mask(out, ratio).astype(out.dtype)
+                elif m.kind == "row":
+                    ratio = _ratio_at(step, m.offset, m.end,
+                                      1.0 - float(m.params.get("dense_ratio", 0.5)))
+                    q = out * _row_mask(out, ratio).astype(out.dtype)
+                else:  # head
+                    nh = int(m.params.get("num_heads", 1))
+                    ratio = _ratio_at(step, m.offset, m.end,
+                                      1.0 - float(m.params.get("dense_ratio", 0.5)))
+                    q = out * _head_mask(out, ratio, nh).astype(out.dtype)
+                out = out + jax.lax.stop_gradient(q - out) if ste else q
+            return out
+
+        return jax.tree_util.tree_unflatten(
+            treedef, [one(kp, p) for kp, p in flat])
+
+
+def init_compression(params: Any, compression_config: Dict,
+                     mpu=None) -> Tuple[Any, CompressionScheduler]:
+    """Reference ``init_compression`` (``compress.py:97``). Returns
+    ``(params, scheduler)`` — params unchanged (compression applies in the
+    compute path); the scheduler drives per-step intensity. The engine calls
+    this automatically when the ``compression_training`` block is present."""
+    return params, CompressionScheduler(compression_config)
+
+
+def redundancy_clean(params: Any, compression_config: Dict) -> Any:
+    """Reference ``redundancy_clean`` (``compress.py:127``): bake the FINAL
+    masks/quantization into the weights (post-training export). Equivalent to
+    applying the scheduler at step=inf without STE."""
+    sched = CompressionScheduler(compression_config)
+    return sched.apply(params, step=jnp.asarray(10 ** 9), ste=False)

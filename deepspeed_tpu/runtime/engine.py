@@ -264,6 +264,15 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_learning)
+        self._compression = None
+        if self._config.compression_config:
+            from ..compression.compress import init_compression
+
+            if self._offload:
+                raise ValueError("compression_training requires the fused "
+                                 "device step (not offload_optimizer)")
+            _, self._compression = init_compression(
+                None, self._config.compression_config)
         self._moq = None
         if self._config.quantize_training.enabled:
             from .quantize import Quantizer
@@ -309,12 +318,13 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         elif self._onebit_wire:
             from .onebit_engine import build_onebit_wire
 
-            if self._moq is not None or self._pld is not None:
+            if self._moq is not None or self._pld is not None or \
+                    self._compression is not None:
                 raise ValueError(
                     "compressed 1-bit training does not compose with "
-                    "quantize_training (MoQ) or progressive_layer_drop; "
-                    "disable those blocks or use the optax 1-bit optimizers "
-                    "(no comm_backend_name)")
+                    "quantize_training (MoQ), progressive_layer_drop, or "
+                    "compression_training; disable those blocks or use the "
+                    "optax 1-bit optimizers (no comm_backend_name)")
 
             opt_state, ob_shardings, step_fn = build_onebit_wire(
                 self, dict(opt_cfg.params or {}))
@@ -445,6 +455,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         gas = self.gradient_accumulation_steps
         pld = self._pld
         moq = self._moq
+        compression = self._compression
 
         def compute_loss(params, batch, rng, scale, pld_theta, moq_step=None):
             # loss_fns marked ``casts_params`` (pipeline) cast inside their
@@ -459,6 +470,11 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 # progressive schedule; fp32 masters stay full precision
                 # (reference runtime/quantize.py quantizes the fp16 copies)
                 params = moq.quantize_tree(params, moq_step, rng)
+            if compression is not None and moq_step is not None:
+                # compression scheduler: pruning/quantization masks at this
+                # step's intensity (reference engine.py:1620 steps the
+                # compression_scheduler during training)
+                params = compression.apply(params, moq_step)
             if loss_fn is not None:
                 loss, aux = loss_fn(params, batch, rng)
             elif pld_theta is not None:
@@ -479,7 +495,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             # PLD keep-rate for THIS step (reference passes pld state into
             # forward each step, engine.py:1636)
             pld_theta = pld.get_theta(state.step) if pld is not None else None
-            moq_step = state.step if moq is not None else None
+            moq_step = state.step if (moq is not None or
+                                      compression is not None) else None
 
             if gas > 1:
                 rngs = jax.random.split(rng, gas)
@@ -763,10 +780,17 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         return self.train_batch(batch=batch)
 
     def _compile_eval_step(self):
-        def eval_step(params, batch, rng):
+        def eval_step(params, batch, rng, step):
             half = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            # eval must see the SAME weight transforms as training (reference
+            # compressed modules mask in eval forward too) — otherwise
+            # pruning/quantization degradation is invisible until export
+            if self._moq is not None:
+                half = self._moq.quantize_tree(half, step, rng)
+            if self._compression is not None:
+                half = self._compression.apply(half, step)
             if self.loss_fn is not None:
                 loss, _ = self.loss_fn(half, batch, rng)
             else:
@@ -775,7 +799,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
         return jax.jit(eval_step, in_shardings=(
             self.param_shardings, NamedSharding(self.mesh, PartitionSpec(BATCH_AXES)),
-            self._replicated), out_shardings=self._replicated)
+            self._replicated, self._replicated), out_shardings=self._replicated)
 
     def eval_batch(self, batch: Dict[str, Any]):
         if self._eval_step is None:
@@ -784,7 +808,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         # fixed rng: eval losses are reproducible call-to-call (stochastic
         # layers like MoE gating see the same noise for the same batch)
         return self._eval_step(self.state.params, mb,
-                               jax.random.PRNGKey(self._config.seed))
+                               jax.random.PRNGKey(self._config.seed),
+                               self.state.step)
 
     # ------------------------------------------------------------------
     # introspection (reference config accessor properties engine.py:466-788)
