@@ -1,13 +1,20 @@
 """Benchmark: Llama decoder training throughput on the real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
 Headline comparison: achieved model TFLOPs/chip on a causal-LM train step vs
 the reference's headline "ZeRO-3 >157 TFLOPs/GPU" (A100) number
 (reference docs/_posts/2022-07-26-deepspeed-azure.md:37).
+
+Adaptive: candidate configurations are tried best-first (dots-remat saves
+matmul outputs — ~no recompute FLOPs — and bigger batches fill the MXU;
+full remat is the safe fallback) under a wall-clock budget; OOM or compile
+failure on one candidate falls through to the next. Diagnostics go to
+stderr; stdout carries only the final JSON line.
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -15,6 +22,9 @@ import numpy as np
 # Persistent compilation cache: first compile over the tunneled TPU can take
 # minutes; cached reruns start in seconds.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench_cache")
+
+BASELINE_TFLOPS = 157.0  # reference ZeRO-3 headline (A100)
+SEQ = 1024
 
 
 def model_flops_per_step(n_params: int, batch: int, seq: int, n_layer: int,
@@ -24,23 +34,34 @@ def model_flops_per_step(n_params: int, batch: int, seq: int, n_layer: int,
     return 6.0 * n_params * tokens + 12.0 * n_layer * batch * seq * seq * hidden
 
 
-def main():
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_candidate(tag, remat_policy, batch, steps=8, warmup=2):
     import jax
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.parallel import topology
 
-    # ~400M-param Llama on one v5e chip, bf16 compute + fp32 master + Adam.
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-                      num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-                      max_position_embeddings=1024, remat=True, attention_impl="flash")
+    topology.set_mesh(None, None)
+    if os.environ.get("DS_BENCH_TINY"):  # harness smoke test (CPU)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=SEQ,
+                          remat=True, remat_policy=remat_policy,
+                          attention_impl="flash")
+    else:
+        cfg = LlamaConfig.llama_400m(max_position_embeddings=SEQ, remat=True,
+                                     remat_policy=remat_policy,
+                                     attention_impl="flash")
     model = LlamaForCausalLM(cfg)
-    B, T = 8, 1024
     rs = np.random.RandomState(0)
-    ids = rs.randint(0, cfg.vocab_size, (B, T))
+    ids = rs.randint(0, cfg.vocab_size, (batch, SEQ))
 
     config = {
-        "train_batch_size": B,
+        "train_batch_size": batch,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
@@ -51,35 +72,79 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(
         engine.state.params))
 
-    batch = {"input_ids": ids, "labels": ids}
+    b = {"input_ids": ids, "labels": ids}
     # warmup / compile; value fetch is the only reliable device fence on the
     # tunneled TPU platform (block_until_ready returns early there)
-    for _ in range(3):
-        loss = engine.train_batch(batch=batch)
+    for _ in range(warmup):
+        loss = engine.train_batch(batch=b)
     float(loss)
-
-    steps = 10
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = engine.train_batch(batch=batch)
+        loss = engine.train_batch(batch=b)
     loss_val = float(loss)  # forces the whole donated-state chain
     dt = (time.perf_counter() - t0) / steps
 
-    flops = model_flops_per_step(n_params, B, T, cfg.num_hidden_layers, cfg.hidden_size)
-    tflops = flops / dt / 1e12
-    tokens_per_sec = B * T / dt
-    baseline_tflops_per_gpu = 157.0  # reference ZeRO-3 headline (A100)
+    flops = model_flops_per_step(n_params, batch, SEQ, cfg.num_hidden_layers,
+                                 cfg.hidden_size)
+    return {
+        "tag": tag, "tflops": flops / dt / 1e12, "dt": dt, "loss": loss_val,
+        "n_params": n_params, "batch": batch,
+        "tokens_per_sec": batch * SEQ / dt,
+    }
+
+
+def main():
+    if os.environ.get("DS_BENCH_TINY"):
+        # smoke mode must not touch (or wait on) a real accelerator; env vars
+        # cannot switch platforms here (sitecustomize pre-imports jax), the
+        # config route always works (see launcher/launch_worker.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    budget = float(os.environ.get("DS_BENCH_BUDGET_S", "1500"))
+    t_start = time.time()
+    candidates = [
+        ("dots-remat,B16", "dots", 16),
+        ("dots-remat,B8", "dots", 8),
+        ("full-remat,B8", "nothing", 8),  # r1 baseline configuration
+    ]
+    best = None
+    for i, (tag, policy, batch) in enumerate(candidates):
+        elapsed = time.time() - t_start
+        # always leave room for the safe fallback if nothing has succeeded
+        if best is not None and elapsed > budget * 0.66:
+            log(f"bench: budget ({elapsed:.0f}s) — stopping with {best['tag']}")
+            break
+        if policy == "nothing" and best is not None:
+            # the full-remat fallback is strictly dominated by any successful
+            # dots-remat run (same-or-smaller batch, more recompute)
+            break
+        if best is None and i == len(candidates) - 1:
+            log("bench: last candidate (fallback)")
+        try:
+            log(f"bench: trying {tag} ...")
+            rec = run_candidate(tag, policy, batch)
+            log(f"bench: {tag}: {rec['tflops']:.1f} TFLOPs "
+                f"({rec['dt'] * 1e3:.0f} ms/step)")
+            if best is None or rec["tflops"] > best["tflops"]:
+                best = rec
+        except Exception as e:
+            log(f"bench: {tag} FAILED: {type(e).__name__}: {e}")
+    if best is None:
+        raise SystemExit("bench: every candidate failed")
+
     print(json.dumps({
         "metric": "llama400m_train_tflops_per_chip",
-        "value": round(tflops, 2),
+        "value": round(best["tflops"], 2),
         "unit": "TFLOPs/chip",
-        "vs_baseline": round(tflops / baseline_tflops_per_gpu, 4),
+        "vs_baseline": round(best["tflops"] / BASELINE_TFLOPS, 4),
         "detail": {
-            "params": n_params,
-            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-            "step_time_s": round(dt, 4),
-            "batch": B, "seq": T,
-            "loss": loss_val,
+            "config": best["tag"],
+            "params": best["n_params"],
+            "tokens_per_sec_per_chip": round(best["tokens_per_sec"], 1),
+            "step_time_s": round(best["dt"], 4),
+            "batch": best["batch"], "seq": SEQ,
+            "loss": best["loss"],
         },
     }))
 

@@ -22,11 +22,21 @@ import numpy as np
 
 def resolve_remat_policy(name: str):
     """Activation-checkpoint policy by name (shared by all models so the
-    accepted strings cannot drift between model files)."""
+    accepted strings cannot drift between model files).
+
+    ``offload_dots_no_batch`` is the CPU-activation-checkpointing analog
+    (reference ``activation_checkpointing/checkpointing.py:480``
+    ``cpu_checkpointing``): non-batched matmul residuals (the
+    ``dots_no_batch`` set) are saved to PINNED HOST memory instead of HBM —
+    XLA schedules the device↔host copies, replacing the reference's explicit
+    ``.cpu()`` round-trips."""
     policies = {
         "nothing": jax.checkpoint_policies.nothing_saveable,
         "dots": jax.checkpoint_policies.dots_saveable,
         "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "offload_dots_no_batch":
+            jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host"),
     }
     if name not in policies:
         raise ValueError(f"unknown remat_policy {name!r}; one of {sorted(policies)}")

@@ -49,6 +49,8 @@ class LlamaConfig:
     #   "dots"     - save matmul outputs, recompute only elementwise chains
     #                (near-zero extra FLOPs; memory ~= no-remat for big dots)
     #   "dots_no_batch" - save only non-batch matmuls (middle ground)
+    #   "offload_dots_no_batch" - like dots_no_batch but residuals live in
+    #                pinned host memory (CPU activation checkpointing)
     remat_policy: str = "nothing"
 
     @property
@@ -61,6 +63,15 @@ class LlamaConfig:
             vocab_size=128256, hidden_size=4096, intermediate_size=14336,
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=8192, rope_theta=500000.0), **over})
+
+    @staticmethod
+    def llama_400m(**over):
+        """The bench flagship (~400M): shared by bench.py and
+        tools/bench_decode.py so both measure the same model."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=1024), **over})
 
     @staticmethod
     def tiny(**over):

@@ -73,12 +73,19 @@ def DeepSpeedCPUAdam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float
 
 def FusedLamb(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
               weight_decay: float = 0.0, max_coeff: float = 10.0, min_coeff: float = 0.01,
-              **_) -> optax.GradientTransformation:
+              pallas: bool = False, **_) -> optax.GradientTransformation:
     """LAMB with trust-ratio clamping (reference ``fused_lamb.py:12``,
-    ``csrc/lamb/fused_lamb_cuda_kernel.cu``)."""
+    ``csrc/lamb/fused_lamb_cuda_kernel.cu``). ``pallas=True`` routes the
+    Adam-direction sweep through the fused kernel."""
     import jax.numpy as jnp
 
     b1, b2 = float(betas[0]), float(betas[1])
+    if pallas:
+        from .pallas.fused_adam import scale_by_fused_lamb
+
+        return scale_by_fused_lamb(lr, b1=b1, b2=b2, eps=eps,
+                                   weight_decay=weight_decay,
+                                   min_coeff=min_coeff, max_coeff=max_coeff)
 
     # optax.lamb's trust ratio is unclamped; the reference clamps it to
     # [min_coeff, max_coeff], so build the chain with a clamped ratio stage.

@@ -159,3 +159,43 @@ def scale_by_fused_adam(lr=1e-3, b1: float = 0.9, b2: float = 0.999,
         return u, FusedAdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def scale_by_fused_lamb(lr=1e-3, b1: float = 0.9, b2: float = 0.999,
+                        eps: float = 1e-8, weight_decay: float = 0.0,
+                        min_coeff: float = 0.01, max_coeff: float = 10.0,
+                        interpret: Optional[bool] = None
+                        ) -> optax.GradientTransformation:
+    """LAMB on the fused kernel (reference
+    ``csrc/lamb/fused_lamb_cuda_kernel.cu:474``): the Adam direction comes
+    from the single-sweep Pallas kernel; the per-tensor trust ratio (a pair
+    of norms) is a cheap XLA reduction on top — the HBM-bound elementwise
+    sweep stays fused, which is where the CUDA kernel spent its effort too."""
+    inner = scale_by_fused_adam(lr=1.0, b1=b1, b2=b2, eps=eps,
+                                weight_decay=0.0, adam_w_mode=True,
+                                interpret=interpret)
+
+    def init_fn(params):
+        return inner.init(params)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused lamb requires params")
+        u, new_state = inner.update(updates, state, params)
+        lr_t = jnp.asarray(lr(state.count) if callable(lr) else lr, jnp.float32)
+
+        def leaf(u_, p):
+            # inner produced -adam_dir (lr=1); LAMB direction adds decay
+            direction = -u_.astype(jnp.float32) + \
+                weight_decay * p.astype(jnp.float32)
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            d_norm = jnp.linalg.norm(direction)
+            ratio = jnp.where((p_norm > 0) & (d_norm > 0),
+                              p_norm / jnp.maximum(d_norm, 1e-12), 1.0)
+            ratio = jnp.clip(ratio, min_coeff, max_coeff)
+            return (-lr_t * ratio * direction).astype(p.dtype)
+
+        out = jax.tree_util.tree_map(leaf, u, params)
+        return out, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)

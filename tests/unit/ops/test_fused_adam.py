@@ -74,3 +74,33 @@ def test_engine_accepts_pallas_flag():
     s = tx.init(params)
     u, s = tx.update(_tree(1, [(16, 128)]), s, params)
     assert jax.tree_util.tree_structure(u) == jax.tree_util.tree_structure(params)
+
+
+def test_fused_lamb_matches_chain():
+    """Kernel-backed LAMB vs the optax-chain FusedLamb (same math path the
+    reference fused_lamb_cuda_kernel implements)."""
+    from deepspeed_tpu.ops.optimizers import FusedLamb
+    from deepspeed_tpu.ops.pallas.fused_adam import scale_by_fused_lamb
+
+    params = _tree(0, SHAPES)
+    fused = scale_by_fused_lamb(1e-2, weight_decay=0.05, interpret=True)
+    ref = FusedLamb(1e-2, weight_decay=0.05)
+    fs, rs_ = fused.init(params), ref.init(params)
+    fp, rp = params, params
+    for step in range(3):
+        grads = _tree(step + 1, SHAPES)
+        fu, fs = fused.update(grads, fs, fp)
+        fp = optax.apply_updates(fp, fu)
+        ru, rs_ = ref.update(grads, rs_, rp)
+        rp = optax.apply_updates(rp, ru)
+    for k in fp:
+        np.testing.assert_allclose(np.asarray(fp[k]), np.asarray(rp[k]),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_offload_dots_remat_policy_resolves():
+    from deepspeed_tpu.models.layers import resolve_remat_policy
+
+    assert resolve_remat_policy("offload_dots_no_batch") is not None
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        resolve_remat_policy("bogus")

@@ -1,0 +1,84 @@
+"""Inference decode benchmark: TTFT + decode throughput on the real chip.
+
+Counterpart of the reference DS-Inference latency/throughput numbers
+(``docs/_posts/2021-05-05-inference-kernel-optimization.md``): measures
+time-to-first-token (prefill) and steady-state decode tokens/sec for the
+flagship Llama decode graph via ``init_inference`` (whole generation loop in
+one jit). Prints one JSON line per configuration.
+
+Usage: python tools/bench_decode.py [--tiny] [--batch B] [--prompt P] [--new N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CPU smoke test")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--new", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.tiny:
+        # smoke mode must not wait on a real accelerator (env vars cannot
+        # switch platforms here; the config route always works)
+        jax.config.update("jax_platforms", "cpu")
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if args.tiny:
+        cfg = LlamaConfig.tiny(remat=False)
+        args.prompt, args.new = 16, 8
+    else:
+        cfg = LlamaConfig.llama_400m(
+            max_position_embeddings=args.prompt + args.new, remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (args.batch, args.prompt))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jax.numpy.asarray(ids[:1]))["params"]
+    engine = ds.init_inference(model, params=params, dtype="bf16",
+                               max_out_tokens=args.prompt + args.new)
+
+    # TTFT: generation of ONE new token = prefill + single decode step
+    np.asarray(engine.generate(ids, max_new_tokens=1))  # compile
+    t0 = time.perf_counter()
+    np.asarray(engine.generate(ids, max_new_tokens=1))
+    ttft = time.perf_counter() - t0
+
+    # decode throughput from the DIFFERENCE of two full runs (new vs 1 new
+    # token): (new - 1) extra decode steps; avoids subtracting measurements
+    # from differently-compiled programs' overheads
+    np.asarray(engine.generate(ids, max_new_tokens=args.new))  # compile
+    t0 = time.perf_counter()
+    out = np.asarray(engine.generate(ids, max_new_tokens=args.new))
+    dt = time.perf_counter() - t0
+    extra_steps = args.new - 1
+    decode_tps = (args.batch * extra_steps / (dt - ttft)
+                  if extra_steps > 0 and dt > ttft else None)
+
+    print(json.dumps({
+        "metric": "llama400m_decode",
+        "ttft_ms": round(ttft * 1e3, 1),
+        "decode_tokens_per_sec":
+            round(decode_tps, 1) if decode_tps else None,
+        "end_to_end_s": round(dt, 3),
+        "batch": args.batch, "prompt": args.prompt, "new_tokens": args.new,
+    }))
+
+
+if __name__ == "__main__":
+    main()
