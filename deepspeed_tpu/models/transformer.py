@@ -43,16 +43,19 @@ class TransformerConfig:
     pos_offset: int = 0          # OPT stores positions at index pos+2
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0      # NeoX partial rotary (first pct of head_dim)
+    rope_style: str = "half"     # "half" (rotate-half) | "interleaved" (GPT-J)
     activation: str = "gelu"     # "gelu" | "gelu_new" | "relu"
     norm_eps: float = 1e-5
     pre_layernorm: bool = True   # False = post-LN (BERT, OPT-350m)
     parallel_residual: bool = False  # NeoX: x + attn(ln1 x) + mlp(ln2 x)
+    shared_parallel_ln: bool = False  # GPT-J: ONE LN feeds both branches
     embedding_layernorm: bool = False  # BLOOM word_embeddings_layernorm / BERT
     final_layernorm: bool = True
     type_vocab_size: int = 0     # BERT token-type embeddings
     attention_bias: bool = True
     mlp_bias: bool = True
     tie_word_embeddings: bool = False
+    lm_head_bias: bool = False   # GPT-J's lm_head carries a bias
     mlm_head: bool = False       # BERT cls.predictions transform+decoder
     attention_impl: str = "xla"
     scan_layers: bool = True
@@ -69,7 +72,9 @@ class TransformerConfig:
 
     @property
     def rotary_dim(self) -> int:
-        d = int(self.head_dim * self.rotary_pct)
+        # round (not truncate): policies reconstruct rotary_dim from a float
+        # ratio, and int(d/h*h) underestimates for many integer pairs
+        d = int(round(self.head_dim * self.rotary_pct))
         return d - d % 2
 
 
@@ -104,12 +109,24 @@ def _act(name: str):
     }[name]
 
 
-def _apply_rotary_partial(x, cos, sin, rotary_dim):
-    """NeoX-style partial rotary: rotate the first ``rotary_dim`` channels."""
+def _apply_rotary_interleaved(x, cos, sin):
+    """GPT-J-style rotate_every_two: pairs are (x[2i], x[2i+1]), not the
+    rotate-half (x[i], x[i+D/2]) convention."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+
+def _apply_rotary_partial(x, cos, sin, rotary_dim, style="half"):
+    """Partial rotary: rotate the first ``rotary_dim`` channels."""
+    rot_fn = _apply_rotary_full if style == "half" else _apply_rotary_interleaved
     if rotary_dim >= x.shape[-1]:
-        return _apply_rotary_full(x, cos, sin)
+        return rot_fn(x, cos, sin)
     rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
-    return jnp.concatenate([_apply_rotary_full(rot, cos, sin), rest], axis=-1)
+    return jnp.concatenate([rot_fn(rot, cos, sin), rest], axis=-1)
 
 
 class GenericAttention(nn.Module):
@@ -126,8 +143,8 @@ class GenericAttention(nn.Module):
         k = dense(Hkv * D, "k_proj")(x).reshape(B, T, Hkv, D)
         v = dense(Hkv * D, "v_proj")(x).reshape(B, T, Hkv, D)
         if cfg.pos_embedding == "rope":
-            q = _apply_rotary_partial(q, cos, sin, cfg.rotary_dim)
-            k = _apply_rotary_partial(k, cos, sin, cfg.rotary_dim)
+            q = _apply_rotary_partial(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
+            k = _apply_rotary_partial(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
         if layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
             k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
@@ -169,10 +186,11 @@ class TransformerBlock(nn.Module):
         attn = GenericAttention(cfg, name="attn")
         mlp = GenericMLP(cfg, name="mlp")
         if cfg.parallel_residual:
-            # NeoX: both branches read the SAME input, residual-summed once
-            a, layer_cache = attn(ln("ln_attn")(x), cos, sin, bias,
-                                  layer_cache, cache_index)
-            m = mlp(ln("ln_mlp")(x))
+            # NeoX: both branches read the SAME input, residual-summed once;
+            # GPT-J shares ONE LayerNorm between the branches
+            h = ln("ln_attn")(x)
+            a, layer_cache = attn(h, cos, sin, bias, layer_cache, cache_index)
+            m = mlp(h if cfg.shared_parallel_ln else ln("ln_mlp")(x))
             x = x + a + m
         elif cfg.pre_layernorm:
             a, layer_cache = attn(ln("ln_attn")(x), cos, sin, bias,
@@ -302,8 +320,8 @@ class TransformerLMHeadModel(nn.Module):
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             logits = hidden @ embed.T.astype(hidden.dtype)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                              param_dtype=jnp.float32)(hidden)
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              name="lm_head", param_dtype=jnp.float32)(hidden)
         if cache is not None:
             return logits, cache
         if labels is None:

@@ -528,10 +528,66 @@ class HFBertLayerPolicy(_GenericTransformerPolicy):
         return leaves
 
 
+
+class HFGPTJLayerPolicy(_GenericTransformerPolicy):
+    """HF ``GPTJForCausalLM`` → generic decoder (reference
+    ``replace_policy.py`` HFGPTJLayerPolicy): partial INTERLEAVED rotary
+    (rotate_every_two), parallel residual with ONE shared LayerNorm,
+    bias-free attention projections, biased untied lm_head."""
+
+    hf_model_types = ("GPTJForCausalLM", "gptj")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        head_dim = hc.n_embd // hc.n_head
+        act = {"gelu": "gelu", "gelu_new": "gelu_new",
+               "gelu_pytorch_tanh": "gelu_new",
+               "relu": "relu"}[hc.activation_function]
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.n_embd,
+            intermediate_size=getattr(hc, "n_inner", None) or 4 * hc.n_embd,
+            num_hidden_layers=hc.n_layer, num_attention_heads=hc.n_head,
+            max_position_embeddings=hc.n_positions,
+            pos_embedding="rope", rotary_pct=(hc.rotary_dim or head_dim) / head_dim,
+            rope_style="interleaved", parallel_residual=True,
+            shared_parallel_ln=True, activation=act,
+            norm_eps=hc.layer_norm_epsilon, pre_layernorm=True,
+            attention_bias=False, mlp_bias=True, tie_word_embeddings=False,
+            lm_head_bias=True, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}wte.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}ln_f.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}ln_f.bias"])
+        _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+        _set(params, "lm_head/bias", sd["lm_head.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        p = f"{pfx}h.{i}."
+        leaves = {}
+        for hf, fx in [("attn.q_proj", "attn/q_proj"), ("attn.k_proj", "attn/k_proj"),
+                       ("attn.v_proj", "attn/v_proj"),
+                       ("attn.out_proj", "attn/o_proj")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+        for hf, fx in [("mlp.fc_in", "mlp/fc_in"), ("mlp.fc_out", "mlp/fc_out")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+            leaves[f"{fx}/bias"] = sd[f"{p}{hf}.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}ln_1.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}ln_1.bias"]
+        return leaves
+
+
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
-                                HFGPTNeoXLayerPolicy, HFBertLayerPolicy]
+                                HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
+                                HFGPTJLayerPolicy]
 
 
 def match_policy(hf_model) -> Optional[DSPolicy]:

@@ -521,6 +521,9 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
             # unscale
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+            import optax as _optax
+
+            grad_norm = _optax.global_norm(grads)
 
             if fp16:
                 overflow = tree_overflow(grads)
@@ -547,7 +550,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 loss_scale=new_scale,
                 skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0),
             )
-            return new_state, loss, overflow
+            return new_state, (loss, grad_norm), overflow
 
         # raw Python step kept for the flops profiler's jaxpr walk
         self._train_step_fn = train_step
@@ -556,7 +559,9 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             # batch shardings follow the device_put placement from
             # _shape_batch (per-leaf: token dims ride the seq axis)
             in_shardings=(self.state_shardings, None, self._replicated),
-            out_shardings=(self.state_shardings, self._replicated, self._replicated),
+            out_shardings=(self.state_shardings,
+                           (self._replicated, self._replicated),
+                           self._replicated),
             donate_argnums=(0,),
         )
 
@@ -607,7 +612,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         batch = self._shape_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
         grads, loss = self._grad_step(self.state.params, batch, step_rng)
-        new_params, overflow, _ = self._host_opt.step(jax.device_get(grads))
+        new_params, overflow, grad_norm = self._host_opt.step(jax.device_get(grads))
+        self._last_grad_norm = grad_norm
         if overflow:
             self.skipped_steps += 1
             self.state = self.state.replace(
@@ -707,7 +713,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             fp = self._config.flops_profiler
             profiling = (fp.enabled and self.global_steps == fp.profile_step)
             t0 = time.perf_counter() if profiling else None
-            self.state, loss, overflow = self._train_step(self.state, batch, step_rng)
+            self.state, (loss, self._last_grad_norm), overflow = \
+                self._train_step(self.state, batch, step_rng)
             if profiling:
                 float(loss)  # device fence so the measured latency is real
                 self._print_flops_profile(batch, step_rng,
@@ -823,7 +830,15 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         return self._config.zero_optimization_stage
 
     def get_global_grad_norm(self):
-        return None  # populated when wall_clock_breakdown/monitor requests it
+        """Global (pre-clip) grad L2 norm of the LAST step (reference
+        monitoring contract, ``engine.get_global_grad_norm``). The fused
+        step computes it on device; fetching forces only a scalar. Returns
+        None for skipped (overflow) steps — their norm is inf/NaN and the
+        reference reports nothing for them either."""
+        if getattr(self, "_last_grad_norm", None) is None:
+            return None
+        norm = float(jax.device_get(self._last_grad_norm))
+        return norm if np.isfinite(norm) else None
 
     @property
     def loss_scale(self):
@@ -854,6 +869,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         ]
         if self.fp16_enabled:
             events.append(("Train/Samples/loss_scale", self.loss_scale,
+                           self.global_steps * self.train_batch_size))
+        gn = self.get_global_grad_norm()
+        if gn is not None:
+            events.append(("Train/Samples/grad_norm", gn,
                            self.global_steps * self.train_batch_size))
         self.monitor.write_events(events)
 

@@ -114,6 +114,11 @@ def build_onebit_wire(engine, opt_params: dict):
         loss_local, g = jax.value_and_grad(local_loss)(params, batch, rng)
         loss = jax.lax.pmean(loss_local, axis_tuple)
         flat_g = jnp.pad(ravel_pytree(g)[0], (0, n_pad - n))
+        # monitoring: norm of the MEAN gradient (exact in warmup; in the
+        # compression phase the mean is never materialized, so this reports
+        # the norm of the averaged-by-psum local grads, which equals it)
+        g_mean = jax.lax.pmean(flat_g, axis_tuple)
+        grad_norm = jnp.sqrt(jnp.sum(g_mean * g_mean))
 
         in_warmup = count <= freeze_step
 
@@ -143,7 +148,7 @@ def build_onebit_wire(engine, opt_params: dict):
         upd = mu2 / bc1 / (jnp.sqrt(nu2 / bc2) + eps)
         new_flat = flat_p_pad - lr_t * (upd + weight_decay * flat_p_pad)
         new_params = unravel(new_flat[:n])
-        return (new_params, mu2, nu2, werr2[None], serr2[None], loss)
+        return (new_params, mu2, nu2, werr2[None], serr2[None], loss, grad_norm)
 
     def train_step(state, batch, rng):
         count = state.step + 1
@@ -153,13 +158,13 @@ def build_onebit_wire(engine, opt_params: dict):
             spmd, mesh=mesh, axis_names=frozenset(axes),
             in_specs=(P(), P(), P(), P(axes), P(axes), P(),
                       P(axis_tuple), P()),
-            out_specs=(P(), P(), P(), P(axes), P(axes), P()),
+            out_specs=(P(), P(), P(), P(axes), P(axes), P(), P()),
             check_vma=False)
-        new_params, mu2, nu2, werr2, serr2, loss = fn(
+        new_params, mu2, nu2, werr2, serr2, loss, grad_norm = fn(
             state.params, mu, nu, werr, serr, count, squeezed, rng)
         new_state = state.replace(
             step=count, params=new_params,
             opt_state=OneBitWireState(mu2, nu2, werr2, serr2))
-        return new_state, loss, jnp.bool_(False)
+        return new_state, (loss, grad_norm), jnp.bool_(False)
 
     return opt_state, opt_shardings, train_step

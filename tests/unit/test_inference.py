@@ -274,6 +274,11 @@ def _tiny_hf(family, seed=0):
             max_position_embeddings=64, rotary_pct=0.25,
             attention_dropout=0.0, hidden_dropout=0.0)
         return transformers.GPTNeoXForCausalLM(cfg).eval()
+    if family == "gptj":
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        return transformers.GPTJForCausalLM(cfg).eval()
     if family == "bert":
         cfg = transformers.BertConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -284,7 +289,7 @@ def _tiny_hf(family, seed=0):
     raise ValueError(family)
 
 
-@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "bert"])
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "bert", "gptj"])
 @pytest.mark.parametrize("scan_layers", [True, pytest.param(False, marks=pytest.mark.slow)])
 def test_generic_policy_logits_parity(family, scan_layers):
     torch = pytest.importorskip("torch")
@@ -301,7 +306,7 @@ def test_generic_policy_logits_parity(family, scan_layers):
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox"])
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "gptj"])
 def test_generic_decoder_generate_matches_hf_greedy(family):
     torch = pytest.importorskip("torch")
     import deepspeed_tpu as ds
