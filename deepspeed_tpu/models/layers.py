@@ -92,7 +92,8 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 def dot_product_attention(q, k, v, bias=None, causal: bool = False,
                           attention_impl: str = "xla", dropout_rng=None,
-                          dropout_rate: float = 0.0, deterministic: bool = True):
+                          dropout_rate: float = 0.0, deterministic: bool = True,
+                          scale: Optional[float] = None):
     """[B, T, H, D] attention core.
 
     ``attention_impl='flash'`` routes to the Pallas flash-attention kernel
@@ -109,8 +110,12 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
     if attention_impl == "flash" and bias is None and not use_dropout:
         from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
     if attention_impl == "ulysses":
+        if scale is not None:
+            raise NotImplementedError(
+                "attention_impl='ulysses' does not support a custom "
+                "attention scale")
         if use_dropout:
             # falling back to plain attention would quietly materialize the
             # O(T^2) logits sequence parallelism exists to avoid
@@ -121,6 +126,10 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
 
         return ulysses_attention(q, k, v, causal=causal, bias=bias)
     if attention_impl == "ring":
+        if scale is not None:
+            raise NotImplementedError(
+                "attention_impl='ring' does not support a custom attention "
+                "scale")
         if use_dropout or bias is not None:
             raise NotImplementedError(
                 "ring attention supports causal masking only (no additive "
@@ -130,7 +139,8 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
         return ring_attention(q, k, v, causal=causal)
 
     depth = q.shape[-1]
-    scale = 1.0 / np.sqrt(depth)
+    if scale is None:
+        scale = 1.0 / np.sqrt(depth)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         logits = logits + make_causal_mask(q.shape[1], k.shape[1], dtype=jnp.float32,

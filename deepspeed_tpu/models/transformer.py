@@ -53,11 +53,19 @@ class TransformerConfig:
     final_layernorm: bool = True
     type_vocab_size: int = 0     # BERT token-type embeddings
     attention_bias: bool = True
+    #: output-projection bias override (GPT-Neo: q/k/v bias-free, o biased)
+    attention_out_bias: Optional[bool] = None
+    #: None = 1/sqrt(head_dim); GPT-Neo uses UNscaled attention (1.0)
+    attention_scale: Optional[float] = None
     mlp_bias: bool = True
     tie_word_embeddings: bool = False
     lm_head_bias: bool = False   # GPT-J's lm_head carries a bias
     mlm_head: bool = False       # BERT cls.predictions transform+decoder
     attention_impl: str = "xla"
+    # GPT-Neo: per-layer attention kind, e.g. ("global","local",...) cycled
+    # over layers; "local" limits causal attention to a sliding window
+    attention_layers: Optional[tuple] = None
+    attention_window: int = 256
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "nothing"
@@ -137,11 +145,13 @@ class GenericAttention(nn.Module):
         cfg = self.config
         B, T, _ = x.shape
         H, Hkv, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(feats, use_bias=cfg.attention_bias,
-                                             name=name, param_dtype=jnp.float32)
-        q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
-        k = dense(Hkv * D, "k_proj")(x).reshape(B, T, Hkv, D)
-        v = dense(Hkv * D, "v_proj")(x).reshape(B, T, Hkv, D)
+        dense = lambda feats, name, bias: nn.Dense(feats, use_bias=bias,
+                                                   name=name,
+                                                   param_dtype=jnp.float32)
+        ab = cfg.attention_bias
+        q = dense(H * D, "q_proj", ab)(x).reshape(B, T, H, D)
+        k = dense(Hkv * D, "k_proj", ab)(x).reshape(B, T, Hkv, D)
+        v = dense(Hkv * D, "v_proj", ab)(x).reshape(B, T, Hkv, D)
         if cfg.pos_embedding == "rope":
             q = _apply_rotary_partial(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
             k = _apply_rotary_partial(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
@@ -149,7 +159,8 @@ class GenericAttention(nn.Module):
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
             k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
             v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
-            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+            out = dot_product_attention(q, k, v, bias=bias, causal=False,
+                                        scale=cfg.attention_scale)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)
@@ -157,9 +168,11 @@ class GenericAttention(nn.Module):
             # only fires for pure-causal no-bias configs
             impl = cfg.attention_impl if bias is None else "xla"
             out = dot_product_attention(q, k, v, bias=bias, causal=cfg.causal,
-                                        attention_impl=impl)
+                                        attention_impl=impl,
+                                        scale=cfg.attention_scale)
         out = out.reshape(B, T, H * D)
-        return dense(cfg.hidden_size, "o_proj")(out), layer_cache
+        ob = ab if cfg.attention_out_bias is None else cfg.attention_out_bias
+        return dense(cfg.hidden_size, "o_proj", ob)(out), layer_cache
 
 
 class GenericMLP(nn.Module):
@@ -209,10 +222,16 @@ class _ScanBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, carry, layer_cache):
+    def __call__(self, carry, xs):
+        layer_cache, local_sel = xs
         x, cos, sin, bias, cache_index = carry
+        layer_bias = bias
+        if local_sel is not None:
+            # bias is (global_bias, local_bias); select this layer's variant
+            # (carry keeps the PAIR so the scan structure stays invariant)
+            layer_bias = jnp.where(local_sel, bias[1], bias[0])
         x, layer_cache = TransformerBlock(self.config, name="block")(
-            x, cos, sin, bias, layer_cache, cache_index)
+            x, cos, sin, layer_bias, layer_cache, cache_index)
         return (x, cos, sin, bias, cache_index), layer_cache
 
 
@@ -271,6 +290,33 @@ class TransformerModel(nn.Module):
             ab = alibi_bias(cfg.num_attention_heads, kv_len)
             bias = ab if bias is None else bias + ab
 
+        # per-layer local-window masking (GPT-Neo): layer i's bias gets a
+        # sliding-window restriction when its kind is "local". The window
+        # bias is built ONCE and selected per layer by a scalar riding the
+        # scan xs, so the compiled block stays uniform.
+        local_sel = None
+        kinds = None
+        if cfg.attention_layers is not None:
+            kinds = [cfg.attention_layers[i % len(cfg.attention_layers)]
+                     for i in range(cfg.num_hidden_layers)]
+            if not any(k == "local" for k in kinds):
+                kinds = None  # all-global: no window machinery, flash stays on
+        if kinds is not None:
+            local_sel = jnp.asarray([k == "local" for k in kinds], jnp.bool_)
+            if cache is not None:
+                q_pos = (cache_index + jnp.arange(T))[:, None]
+                k_pos = jnp.arange(kv_len)[None, :]
+            else:
+                q_pos = jnp.arange(T)[:, None]
+                k_pos = jnp.arange(kv_len)[None, :]
+            in_window = (q_pos - k_pos) < cfg.attention_window
+            window_bias = jnp.where(in_window, 0.0, -1e9)[None, None]
+            zero = jnp.zeros_like(window_bias)
+            local_bias = window_bias if bias is None else bias + window_bias
+            bias = zero if bias is None else bias
+            # pack both variants; the block indexes by the layer selector
+            bias = (bias, local_bias)
+
         if cfg.scan_layers:
             block_cls = _ScanBlock
             if cfg.remat and cache is None:
@@ -280,7 +326,7 @@ class TransformerModel(nn.Module):
                            split_rngs={"params": True},
                            length=cfg.num_hidden_layers, metadata_params={})
             (x, *_), cache = scan(cfg, name="layers")(
-                (x, cos, sin, bias, cache_index), cache)
+                (x, cos, sin, bias, cache_index), (cache, local_sel))
         else:
             block_cls = nn.remat(
                 TransformerBlock, prevent_cse=False,
@@ -290,8 +336,10 @@ class TransformerModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 layer_cache = None if cache is None else \
                     jax.tree_util.tree_map(lambda c: c[i], cache)
+                lbias = bias if kinds is None else \
+                    (bias[1] if kinds[i] == "local" else bias[0])
                 x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
-                    x, cos, sin, bias, layer_cache, cache_index)
+                    x, cos, sin, lbias, layer_cache, cache_index)
                 if new_cache is not None:
                     new_cache.append(layer_cache)
             if new_cache is not None:

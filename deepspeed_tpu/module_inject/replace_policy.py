@@ -583,11 +583,74 @@ class HFGPTJLayerPolicy(_GenericTransformerPolicy):
         return leaves
 
 
+
+class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
+    """HF ``GPTNeoForCausalLM`` → generic decoder (reference
+    ``replace_policy.py`` HFGPTNEOLayerPolicy): learned positions,
+    ALTERNATING global/local (sliding-window) attention per layer, UNscaled
+    attention logits, bias-free q/k/v with a biased output projection."""
+
+    hf_model_types = ("GPTNeoForCausalLM", "gpt_neo")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        act = {"gelu": "gelu", "gelu_new": "gelu_new", "relu": "relu"}[
+            hc.activation_function]
+        # hc.attention_layers is the FULLY expanded per-layer list (HF
+        # expands attention_types blocks); never reconstruct it from the
+        # first block alone
+        pattern = tuple(getattr(hc, "attention_layers", None) or ("global",))
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=getattr(hc, "intermediate_size", None)
+            or 4 * hc.hidden_size,
+            num_hidden_layers=hc.num_layers,
+            num_attention_heads=hc.num_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            pos_embedding="learned", activation=act,
+            norm_eps=hc.layer_norm_epsilon, pre_layernorm=True,
+            attention_bias=False, attention_out_bias=True,
+            attention_scale=1.0,  # GPT-Neo does not scale by 1/sqrt(d)
+            attention_layers=pattern,
+            attention_window=getattr(hc, "window_size", 256),
+            mlp_bias=True, tie_word_embeddings=True, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        _set(params, "model/embed_tokens/embedding", sd[f"{pfx}wte.weight"])
+        _set(params, "model/embed_positions/embedding", sd[f"{pfx}wpe.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}ln_f.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}ln_f.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        p = f"{pfx}h.{i}."
+        leaves = {}
+        for hf, fx in [("attn.attention.q_proj", "attn/q_proj"),
+                       ("attn.attention.k_proj", "attn/k_proj"),
+                       ("attn.attention.v_proj", "attn/v_proj")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+        leaves["attn/o_proj/kernel"] = sd[f"{p}attn.attention.out_proj.weight"].T
+        leaves["attn/o_proj/bias"] = sd[f"{p}attn.attention.out_proj.bias"]
+        for hf, fx in [("mlp.c_fc", "mlp/fc_in"), ("mlp.c_proj", "mlp/fc_out")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+            leaves[f"{fx}/bias"] = sd[f"{p}{hf}.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}ln_1.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}ln_1.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}ln_2.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}ln_2.bias"]
+        return leaves
+
+
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
-                                HFGPTJLayerPolicy]
+                                HFGPTJLayerPolicy, HFGPTNeoLayerPolicy]
 
 
 def match_policy(hf_model) -> Optional[DSPolicy]:

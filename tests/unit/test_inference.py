@@ -279,6 +279,13 @@ def _tiny_hf(family, seed=0):
             vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
             rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
         return transformers.GPTJForCausalLM(cfg).eval()
+    if family == "gpt_neo":
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, max_position_embeddings=64, hidden_size=32,
+            num_layers=4, num_heads=4,
+            attention_types=[[["global", "local"], 2]], window_size=4,
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0)
+        return transformers.GPTNeoForCausalLM(cfg).eval()
     if family == "bert":
         cfg = transformers.BertConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -289,7 +296,8 @@ def _tiny_hf(family, seed=0):
     raise ValueError(family)
 
 
-@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "bert", "gptj"])
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "bert", "gptj",
+                                    "gpt_neo"])
 @pytest.mark.parametrize("scan_layers", [True, pytest.param(False, marks=pytest.mark.slow)])
 def test_generic_policy_logits_parity(family, scan_layers):
     torch = pytest.importorskip("torch")
@@ -306,7 +314,8 @@ def test_generic_policy_logits_parity(family, scan_layers):
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "gptj"])
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox", "gptj",
+                                    "gpt_neo"])
 def test_generic_decoder_generate_matches_hf_greedy(family):
     torch = pytest.importorskip("torch")
     import deepspeed_tpu as ds
