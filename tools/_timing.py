@@ -1,0 +1,31 @@
+"""Shared benchmark timing helper.
+
+One copy of the dispatch-then-sync loop: value fetch is the only reliable
+device fence on the tunneled TPU platform (block_until_ready returns early
+there), so every bench in the repo times via a scalar device_get.
+"""
+
+import time
+
+import numpy as np
+
+
+def time_fn(fn, *args, steps: int = 5, warmup: int = 1) -> float:
+    """Mean seconds/step. Warms up (compiles), fences, times ``steps``."""
+    import jax
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
+    if leaves:
+        np.asarray(jax.device_get(
+            leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
+    if leaves:
+        np.asarray(jax.device_get(
+            leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
+    return (time.perf_counter() - t0) / steps

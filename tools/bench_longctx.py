@@ -1,10 +1,10 @@
-"""Long-context attention benchmark: flash vs block-sparse vs ring at long T.
+"""Long-context attention benchmark: flash vs block-sparse at long T.
 
-Evidence for the long-context capability surface (reference levers:
-block-sparse attention `ops/sparse_attention/`; ours adds flash + Ulysses +
-ring). Single chip measures flash vs block-sparse scaling with T; the ring
-variant needs a seq mesh axis (run under the launcher on multiple
-processes, or on the CPU mesh with --cpu).
+Evidence for the long-context capability surface (reference lever:
+block-sparse attention `ops/sparse_attention/`; ours adds flash + the
+sequence-parallel attention in `sequence/` — the Ulysses/ring variants need
+a seq mesh axis and are exercised by `tests/unit/test_sequence.py` and the
+driver dryrun rather than this single-chip script).
 
 Usage: python tools/bench_longctx.py [--cpu] [--seqs 4096,8192,16384]
 """
@@ -21,16 +21,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench(fn, *args, steps=5):
-    import jax
-
-    out = fn(*args)
-    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
-    return (time.perf_counter() - t0) / steps
+from _timing import time_fn as bench  # noqa: E402 (shared sync-safe timer)
 
 
 def main():

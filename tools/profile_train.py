@@ -19,25 +19,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_fn(fn, *args, steps=5, warmup=2):
-    import jax
+from _timing import time_fn
 
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.tree_util.tree_map(
-        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
-    # value fetch is the only reliable fence on the tunneled TPU platform
-    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
-    if leaves:
-        np.asarray(jax.device_get(leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
-    if leaves:
-        np.asarray(jax.device_get(leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
-    return (time.perf_counter() - t0) / steps
+
+def bench_fn(fn, *args, steps=5, warmup=2):
+    return time_fn(fn, *args, steps=steps, warmup=warmup)
 
 
 def flops_fwd(n_params, batch, seq, n_layer, hidden):
