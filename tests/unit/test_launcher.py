@@ -98,3 +98,22 @@ def test_cli_launches_two_process_training(tmp_path):
         env=env, capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stdout + out.stderr
     assert out.stdout.count("OK rank") == 2
+
+
+@pytest.mark.slow
+def test_ds_bench_comm_sweep():
+    """ds_bench (reference benchmarks/communication) emits one JSON record
+    per (op, size) with sane bandwidth numbers."""
+    import json
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_bench"), "--cpu",
+         "--devices", "8", "--sizes-mb", "0.5", "--steps", "2"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    recs = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert {r["op"] for r in recs} == {"all_reduce", "all_gather",
+                                       "reduce_scatter", "all_to_all", "p2p"}
+    assert all(r["algbw_gbps"] > 0 and r["world"] == 8 for r in recs)
