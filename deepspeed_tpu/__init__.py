@@ -47,3 +47,41 @@ def argparse_suppress():
     import argparse
 
     return argparse.SUPPRESS
+
+
+#: reference-parity shortcut (``deepspeed.init_distributed``)
+init_distributed = comm.init_distributed
+
+
+_LAZY_MODULES = {"zero": ".runtime.zero", "moe": ".moe", "ops": ".ops",
+                 "pipe": ".pipe", "module_inject": ".module_inject"}
+_LAZY_NAMES = {
+    "DeepSpeedEngine": (".runtime.engine", "DeepSpeedEngine"),
+    "PipelineEngine": (".pipe.engine", "PipelineEngine"),
+    "PipelineModule": (".pipe.module", "PipelineModule"),
+    "DeepSpeedConfig": (".runtime.config", "DeepSpeedConfig"),
+    "InferenceEngine": (".inference.engine", "InferenceEngine"),
+}
+
+
+def __getattr__(name):
+    """Lazy module/class namespaces matching ``deepspeed.*`` (kept lazy so
+    ``import deepspeed_tpu`` stays cheap and backend-neutral). Uses
+    importlib (not ``from . import x``, whose fromlist check re-enters this
+    __getattr__ and recurses)."""
+    import importlib
+
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(_LAZY_MODULES[name], __name__)
+        globals()[name] = mod
+        return mod
+    if name in _LAZY_NAMES:
+        modname, attr = _LAZY_NAMES[name]
+        val = getattr(importlib.import_module(modname, __name__), attr)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_MODULES) | set(_LAZY_NAMES))

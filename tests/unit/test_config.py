@@ -96,3 +96,20 @@ def test_parallel_block():
                           world_size=2)
     topo = cfg.parallel.topology()
     assert topo.model == 2 and topo.pipe == 2
+
+
+def test_reference_api_namespace_parity():
+    """deepspeed.* surface names resolve (reference deepspeed/__init__.py):
+    module namespaces, engine classes, zero.Init/GatheredParameters."""
+    import deepspeed_tpu as ds
+
+    assert callable(ds.initialize) and callable(ds.init_inference)
+    assert callable(ds.add_config_arguments) and callable(ds.init_distributed)
+    assert callable(ds.zero.Init) and callable(ds.zero.GatheredParameters)
+    assert hasattr(ds.moe, "layer") and hasattr(ds.ops, "optimizers")
+    assert ds.PipelineModule is not None and ds.PipelineEngine is not None
+    assert ds.DeepSpeedEngine is not None and ds.DeepSpeedConfig is not None
+    assert ds.InferenceEngine is not None
+    import pytest as _p
+    with _p.raises(AttributeError):
+        ds.not_a_thing
