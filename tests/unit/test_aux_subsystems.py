@@ -236,3 +236,40 @@ def test_engine_flops_profiler_hook(capsys):
     # fwd+bwd+opt must exceed 2 forward passes of 2*N*tokens
     n, toks = prof.get_total_params(), 8 * 16
     assert prof.get_total_flops() > 2 * 2 * n * toks
+
+
+def test_schedules_resume_from_checkpoint(tmp_path):
+    """Curriculum/PLD/MoQ schedules are pure functions of the step counters,
+    so save -> fresh engine -> load resumes them exactly (reference
+    checkpoints scheduler state explicitly; here restoring global_steps and
+    state.step IS the scheduler state)."""
+    from deepspeed_tpu.parallel import topology
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "curriculum_learning": {
+                  "enabled": True, "min_difficulty": 8, "max_difficulty": 16,
+                  "schedule_type": "fixed_discrete",
+                  "schedule_config": {"difficulty": [8, 16], "max_step": [3]}}}
+    e1, *_ = ds.initialize(model=model, config=dict(config),
+                           example_batch=_mk_batch(cfg, 1, 16))
+    for _ in range(4):  # steps 0..3 -> difficulty schedule crosses to 16
+        e1.train_batch(batch=_mk_batch(cfg, 8, 32))
+    assert e1.curriculum_scheduler.current_difficulty == 16
+    e1.save_checkpoint(str(tmp_path))
+
+    topology.set_mesh(None, None)
+    e2, *_ = ds.initialize(model=model, config=dict(config),
+                           example_batch=_mk_batch(cfg, 1, 16))
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == e1.global_steps
+    assert int(jax.device_get(e2.state.step)) == \
+        int(jax.device_get(e1.state.step))
+    seen = []
+    orig = e2._shape_batch
+    e2._shape_batch = lambda b: (seen.append(b["input_ids"].shape[1]),
+                                 orig(b))[1]
+    e2.train_batch(batch=_mk_batch(cfg, 8, 32))
+    assert seen == [16], seen  # resumed difficulty, not min_difficulty

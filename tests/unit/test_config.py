@@ -110,6 +110,5 @@ def test_reference_api_namespace_parity():
     assert ds.PipelineModule is not None and ds.PipelineEngine is not None
     assert ds.DeepSpeedEngine is not None and ds.DeepSpeedConfig is not None
     assert ds.InferenceEngine is not None
-    import pytest as _p
-    with _p.raises(AttributeError):
+    with pytest.raises(AttributeError):
         ds.not_a_thing
