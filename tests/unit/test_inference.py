@@ -257,6 +257,11 @@ def _tiny_hf(family, seed=0):
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
     torch.manual_seed(seed)
+    if family == "gpt2":
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        return transformers.GPT2LMHeadModel(cfg).eval()
     if family == "opt":
         cfg = transformers.OPTConfig(
             vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
