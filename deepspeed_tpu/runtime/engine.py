@@ -203,6 +203,11 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             and (opt_cfg.params or {}).get("comm_backend_name") == "compressed")
         self.optimizer = None if (self._offload or self._onebit_wire) \
             else self._build_optimizer()
+        if self._config.sparse_gradients_enabled and (self._offload
+                                                      or self._onebit_wire):
+            raise ValueError("sparse_gradients does not compose with "
+                             "offload_optimizer or wire-compressed 1-bit "
+                             "training (each owns the explicit grad exchange)")
 
         # ---- shardings (ZeRO policy) ------------------------------------
         self.param_shardings, shard_opt = state_shardings(
@@ -338,6 +343,21 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 step_fn,
                 in_shardings=(self.state_shardings, None, self._replicated),
                 out_shardings=(self.state_shardings, self._replicated,
+                               self._replicated),
+                donate_argnums=(0,))
+        elif self._config.sparse_gradients_enabled:
+            # explicit sparse-gradient DP exchange (runtime/sparse_engine.py;
+            # reference sparse_allreduce path, engine.py:2286-2301)
+            from .sparse_engine import build_sparse_dp_step
+
+            self.sparse_tensor_module_names, step_fn = \
+                build_sparse_dp_step(self)
+            self._train_step_fn = step_fn
+            self._train_step = jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, None, self._replicated),
+                out_shardings=(self.state_shardings,
+                               (self._replicated, self._replicated),
                                self._replicated),
                 donate_argnums=(0,))
         else:

@@ -93,19 +93,10 @@ def build_onebit_wire(engine, opt_params: dict):
     opt_shardings = OneBitWireState(mu=repl, nu=repl, worker_error=shard0,
                                     server_error=shard0)
 
-    compute_dtype = engine.compute_dtype
-    loss_fn = engine.loss_fn
     axis_tuple = axes if len(axes) > 1 else axes[0]
+    from .step_common import make_local_loss
 
-    def local_loss(params, batch, rng):
-        half = jax.tree_util.tree_map(
-            lambda p: p.astype(compute_dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
-        if loss_fn is not None:
-            loss, _ = loss_fn(half, batch, rng)
-        else:
-            loss, _ = engine._default_loss(half, batch, rng)
-        return loss.astype(jnp.float32)
+    local_loss = make_local_loss(engine)
 
     def spmd(params, mu, nu, werr, serr, count, batch, rng):
         # per-rank: lose the leading sharded axis of the error buffers

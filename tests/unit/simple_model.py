@@ -2,6 +2,7 @@
 ``tests/unit/simple_model.py`` — ``SimpleModel`` :12 etc.)."""
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -45,6 +46,42 @@ class SimpleMoEModel(nn.Module):
         out = nn.Dense(1)(h)
         loss = jnp.mean((out.squeeze(-1) - y) ** 2)
         return loss + 0.01 * l_aux
+
+
+class EmbedModel(nn.Module):
+    """Embedding-lookup model for the sparse-gradient path (reference
+    registers ``torch.nn.Embedding`` modules when ``sparse_gradients`` is on,
+    ``engine.py:333-337``). Tokens touch few vocab rows, so the embedding
+    gradient is row-sparse."""
+
+    vocab: int = 512
+    hidden_dim: int = 16
+
+    @nn.compact
+    def __call__(self, ids, y):
+        h = nn.Embed(self.vocab, self.hidden_dim, name="wte")(ids)
+        h = nn.relu(nn.Dense(self.hidden_dim)(h))
+        out = nn.Dense(1)(h).squeeze(-1).mean(axis=-1)
+        return jnp.mean((out - y) ** 2)
+
+
+class TiedEmbedModel(nn.Module):
+    """Embedding used BOTH as lookup and as output projection — its gradient
+    is dense (every row written by the projection's VJP), the case torch's
+    sparse+dense autograd mix rejects loudly and our sparse step must flag
+    as capacity overflow rather than silently truncate."""
+
+    vocab: int = 512
+    hidden_dim: int = 16
+
+    @nn.compact
+    def __call__(self, ids):
+        emb = nn.Embed(self.vocab, self.hidden_dim, name="wte")
+        h = nn.relu(nn.Dense(self.hidden_dim)(emb(ids)))
+        logits = emb.attend(h)  # dense grad into the embedding table
+        target = jnp.clip(ids + 1, 0, self.vocab - 1)
+        lab = jax.nn.one_hot(target, self.vocab)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * lab, axis=-1))
 
 
 def random_dataset(n=256, dim=16, seed=0):

@@ -1,0 +1,25 @@
+"""Run ONE bench.py candidate on the real chip (iteration helper).
+
+Usage: python tools/bench_one.py <tag> <remat_policy> <batch> [steps]
+Prints the candidate's JSON record. bench.py remains the driver entry point;
+this exists so perf iteration does not pay for the full candidate ladder.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main():
+    tag, policy, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    steps = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    rec = bench.run_candidate(tag, policy, batch, steps=steps)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
