@@ -1,0 +1,13 @@
+"""Checkpoint subsystem: orbax engines (``engine.py``), offline TP reshaping
+(``reshape.py`` — reference ``deepspeed/checkpoint/`` + ``runtime/
+state_dict_factory.py``), universal topology-agnostic checkpoints
+(``universal.py``)."""
+
+from .engine import (AsyncCheckpointEngine, CheckpointEngine,
+                     OrbaxCheckpointEngine, load_pytree, load_train_state,
+                     save_pytree, save_train_state)
+from .reshape import (ShardedCheckpointLoader, get_sd_loader, infer_rule,
+                      merge_qkv, merge_state_dicts, reshape_tp, split_qkv,
+                      split_state_dict)
+from .universal import (convert_checkpoint, load_universal, restore_into,
+                        save_universal)

@@ -1,0 +1,166 @@
+"""Universal (topology-agnostic) checkpoints.
+
+Counterpart of the reference's universal-checkpoint machinery: the
+``load_universal_checkpoint`` engine flag (``deepspeed/runtime/engine.py:740``)
+and the offline converter pattern (``deepspeed/checkpoint/`` — index a
+topology-bound checkpoint, consolidate each parameter's fp32 master +
+optimizer moments, write one file per parameter keyed by NAME so any target
+topology can re-partition on load).
+
+TPU-native shape: a training checkpoint here is an orbax/tensorstore
+directory, already mesh-agnostic — but still bound to this framework's
+TrainState pytree structure and to tensorstore as a reader. The universal
+form is deliberately lower-tech, matching the reference's goal of a
+checkpoint anything can consume:
+
+    <out_dir>/
+      universal_meta.json   {step, leaf paths -> shape/dtype, client_state}
+      state.npz             one fp32 entry per TrainState leaf, keyed by
+                            "params/<path>" / "opt_state/<path>" flat names
+
+Loading maps entries back by NAME onto the target engine's TrainState and
+``device_put``s each leaf straight into its shard — so a universal
+checkpoint written from a dp=8/ZeRO-3 run restores into tp=4×dp=2, a single
+chip, or a differently-meshed pod without any reshape pass.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _flat_name(kp) -> str:
+    # dict keys (.key), struct/dataclass fields (.name), sequence slots
+    # (.idx) — one canonical name whether the tree is the live TrainState
+    # (attr keys) or a raw orbax restore (dict/list keys)
+    parts = []
+    for k in kp:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _flatten_state(state) -> Dict[str, np.ndarray]:
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if leaf is None:
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype == jax.numpy.bfloat16:
+            arr = arr.astype(np.float32)  # universal = plain-numpy readable
+        flat[_flat_name(kp)] = arr
+    return flat
+
+
+def save_universal(state, out_dir: str, client_state: Optional[Dict] = None,
+                   step: Optional[int] = None) -> None:
+    """Write a TrainState (or any pytree) as a universal checkpoint."""
+    os.makedirs(out_dir, exist_ok=True)
+    flat = _flatten_state(state)
+    np.savez(os.path.join(out_dir, "state.npz"), **flat)
+    meta = {
+        "format": "deepspeed_tpu_universal_v1",
+        "step": int(step) if step is not None else None,
+        "leaves": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                   for k, v in flat.items()},
+        "client_state": client_state or {},
+    }
+    with open(os.path.join(out_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_universal(universal_dir: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Raw (flat state dict, meta) from a universal checkpoint dir."""
+    with open(os.path.join(universal_dir, "universal_meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format") != "deepspeed_tpu_universal_v1":
+        raise ValueError(f"{universal_dir} is not a universal checkpoint")
+    with np.load(os.path.join(universal_dir, "state.npz")) as z:
+        flat = {k: z[k] for k in z.files}
+    return flat, meta
+
+
+def restore_into(template_state, state_shardings, universal_dir: str,
+                 load_optimizer_states: bool = True):
+    """Map a universal checkpoint onto a target TrainState by leaf NAME.
+
+    Every leaf is ``device_put`` directly into its target shard, so the mesh/
+    parallelism of the writing run is irrelevant (the reference's universal
+    loader re-partitions by pattern for the same reason,
+    ``engine.py:740`` + per-param universal files).
+    """
+    flat, meta = load_universal(universal_dir)
+
+    def build(kp, leaf, sharding):
+        name = _flat_name(kp)
+        if leaf is None:
+            return None
+        if not load_optimizer_states and name.startswith("opt_state/"):
+            return leaf
+        if name not in flat:
+            raise KeyError(
+                f"universal checkpoint is missing leaf {name!r} (optimizer "
+                f"mismatch? pass load_optimizer_states=False to keep the "
+                f"engine's fresh optimizer state)")
+        src = flat[name]
+        if tuple(src.shape) != tuple(leaf.shape):
+            raise ValueError(f"shape mismatch for {name}: checkpoint "
+                             f"{src.shape} vs engine {leaf.shape}")
+        return jax.device_put(src.astype(leaf.dtype), sharding)
+
+    leaves = [
+        build(kp, leaf, sharding)
+        for (kp, leaf), sharding in zip(
+            jax.tree_util.tree_flatten_with_path(template_state)[0],
+            jax.tree_util.tree_leaves(
+                state_shardings, is_leaf=lambda x: x is None))
+    ]
+    restored = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template_state), leaves)
+    return restored, meta
+
+
+def convert_checkpoint(ckpt_dir: str, out_dir: str,
+                       tag: Optional[str] = None) -> None:
+    """Offline: engine checkpoint directory → universal directory (the
+    ``ds_to_universal`` CLI body; no engine or device mesh required)."""
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        with open(os.path.join(ckpt_dir, "latest")) as f:
+            tag = f.read().strip()
+    raw = ocp.StandardCheckpointer().restore(
+        os.path.abspath(os.path.join(ckpt_dir, tag)))
+    client_state = {}
+    cs_path = os.path.join(ckpt_dir, f"{tag}.client_state.json")
+    if os.path.exists(cs_path):
+        with open(cs_path) as f:
+            client_state = json.load(f)
+    step = client_state.get("global_steps")
+    save_universal(raw, out_dir, client_state=client_state, step=step)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Convert a deepspeed_tpu training checkpoint to the "
+                    "universal (topology-agnostic npz) format")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_dir")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args(argv)
+    convert_checkpoint(args.checkpoint_dir, args.output_dir, args.tag)
+    print(f"wrote universal checkpoint to {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

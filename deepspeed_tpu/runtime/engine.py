@@ -927,8 +927,26 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
-                        load_optimizer_states: bool = True, **_):
-        """Reference: ``engine.load_checkpoint`` :2531."""
+                        load_optimizer_states: bool = True,
+                        load_universal: Optional[bool] = None, **_):
+        """Reference: ``engine.load_checkpoint`` :2531. With
+        ``load_universal`` (arg or ``checkpoint.load_universal`` config,
+        reference ``engine.py:740``) ``load_dir`` is a universal checkpoint
+        directory (see ``checkpoint/universal.py``) loadable at ANY
+        mesh/parallelism."""
+        if load_universal is None:
+            load_universal = self._config.load_universal_checkpoint
+        if load_universal:
+            from ..checkpoint.universal import restore_into
+
+            state, meta = restore_into(
+                self.state, self.state_shardings, load_dir,
+                load_optimizer_states=load_optimizer_states)
+            self.state = state
+            client_state = meta.get("client_state", {})
+            self.global_steps = int(client_state.get("global_steps",
+                                                     meta.get("step") or 0))
+            return load_dir, client_state
         from ..checkpoint.engine import load_train_state
 
         state, client_state = load_train_state(

@@ -1,0 +1,209 @@
+"""Offline TP reshape (reference ``state_dict_factory.py:214`` Megatron
+merge/split) and universal checkpoints (reference universal-checkpoint load,
+``engine.py:740``)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint.reshape import (ShardedCheckpointLoader,
+                                              merge_qkv, merge_state_dicts,
+                                              reshape_tp, split_qkv,
+                                              split_state_dict)
+from deepspeed_tpu.checkpoint.universal import (convert_checkpoint,
+                                                load_universal)
+
+from tests.unit.simple_model import SimpleModel, batch_of
+
+HEADS, HEAD_DIM, H = 4, 8, 32  # full qkv rows = 3*H = 96
+
+
+def _qkv_v0_shard(rank, n_ranks, seed=0):
+    """v0 layout per rank: [Q(local heads); K(local); V(local)]."""
+    rs = np.random.RandomState(seed + rank)
+    local = 3 * (H // n_ranks)
+    return rs.randn(local, H).astype(np.float32)
+
+
+class TestQKVReshape:
+    def test_v0_merge_interleaves(self):
+        # build the FULL v2-style param, derive per-rank v0 shards, merge back
+        rs = np.random.RandomState(0)
+        q, k, v = (rs.randn(H, H).astype(np.float32) for _ in range(3))
+        full = np.concatenate([q, k, v], axis=0)  # [Q_all; K_all; V_all]
+        n = 4
+        shards = [
+            np.concatenate([np.split(part, n, axis=0)[r] for part in (q, k, v)],
+                           axis=0)
+            for r in range(n)
+        ]  # each rank: [Q_r; K_r; V_r] = version-0 layout
+        np.testing.assert_array_equal(merge_qkv(shards, version=0), full)
+
+    def test_v0_split_roundtrip(self):
+        rs = np.random.RandomState(1)
+        full = rs.randn(3 * H, H).astype(np.float32)
+        shards = [split_qkv(full, 4, r, version=0) for r in range(4)]
+        np.testing.assert_array_equal(merge_qkv(shards, version=0), full)
+
+    def test_v2_is_plain_concat(self):
+        rs = np.random.RandomState(2)
+        full = rs.randn(3 * H, H).astype(np.float32)
+        shards = [split_qkv(full, 2, r, version=2.0) for r in range(2)]
+        np.testing.assert_array_equal(np.concatenate(shards, 0), full)
+
+    def test_v0_and_v2_differ(self):
+        rs = np.random.RandomState(3)
+        full = rs.randn(3 * H, H).astype(np.float32)
+        assert not np.array_equal(split_qkv(full, 2, 0, version=0),
+                                  split_qkv(full, 2, 0, version=2.0))
+
+
+def _mk_full_sd(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "transformer.layers.0.attention.query_key_value.weight":
+            rs.randn(3 * H, H).astype(np.float32),
+        "transformer.layers.0.attention.query_key_value.bias":
+            rs.randn(3 * H).astype(np.float32),
+        "transformer.layers.0.attention.dense.weight":
+            rs.randn(H, H).astype(np.float32),
+        "transformer.layers.0.mlp.dense_h_to_4h.weight":
+            rs.randn(4 * H, H).astype(np.float32),
+        "transformer.layers.0.mlp.dense_4h_to_h.weight":
+            rs.randn(H, 4 * H).astype(np.float32),
+        "transformer.layers.0.input_layernorm.weight":
+            rs.randn(H).astype(np.float32),
+        "word_embeddings.weight": rs.randn(128, H).astype(np.float32),
+    }
+
+
+class TestStateDictReshape:
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_split_merge_roundtrip(self, n):
+        full = _mk_full_sd()
+        shards = [split_state_dict(full, n, r) for r in range(n)]
+        # sharded shapes follow the rules
+        assert shards[0]["word_embeddings.weight"].shape == (128 // n, H)
+        assert shards[0]["transformer.layers.0.attention.dense.weight"].shape \
+            == (H, H // n)
+        assert shards[0]["transformer.layers.0.input_layernorm.weight"].shape \
+            == (H,)
+        merged = merge_state_dicts(shards)
+        for k in full:
+            np.testing.assert_array_equal(merged[k], full[k])
+
+    def test_reshape_degrees(self):
+        full = _mk_full_sd()
+        four = reshape_tp([full], 4)
+        two = reshape_tp(four, 2)     # merge by groups
+        eight = reshape_tp(two, 8)    # split each
+        three_to = reshape_tp(four, 1)
+        for k in full:
+            np.testing.assert_array_equal(three_to[0][k], full[k])
+        re_merged = merge_state_dicts(eight)
+        for k in full:
+            np.testing.assert_array_equal(re_merged[k], full[k])
+
+    def test_loader_merge_and_split_files(self, tmp_path):
+        full = _mk_full_sd()
+        shards = [split_state_dict(full, 2, r) for r in range(2)]
+        paths = []
+        for r, sd in enumerate(shards):
+            p = tmp_path / f"mp_rank_{r:02d}.npz"
+            np.savez(p, **sd)
+            paths.append(str(p))
+        loader = ShardedCheckpointLoader(paths, version=2.0)
+        merged = loader.load(mp_world_size=1, mp_rank=0)
+        for k in full:
+            np.testing.assert_array_equal(merged[k], full[k])
+        quarter = loader.load(mp_world_size=4, mp_rank=3)
+        np.testing.assert_array_equal(
+            quarter["word_embeddings.weight"], full["word_embeddings.weight"][96:])
+
+    def test_loader_torch_files(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        full = _mk_full_sd()
+        p = tmp_path / "mp_rank_00_model_states.pt"
+        torch.save({"module": {k: torch.tensor(v) for k, v in full.items()}},
+                   str(p))
+        loader = ShardedCheckpointLoader([str(p)])
+        half = loader.load(mp_world_size=2, mp_rank=0)
+        np.testing.assert_array_equal(
+            half["word_embeddings.weight"], full["word_embeddings.weight"][:64])
+
+
+CONFIG = {
+    "train_batch_size": 16,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "steps_per_print": 0,
+}
+
+
+def _make_engine(config, seed=11):
+    return ds.initialize(model=SimpleModel(), config=config,
+                         example_batch=batch_of(2),
+                         rng=jax.random.PRNGKey(seed))[0]
+
+
+class TestUniversalCheckpoint:
+    def test_convert_and_resume_across_topology(self, tmp_path):
+        src = _make_engine({**CONFIG, "zero_optimization": {"stage": 3}})
+        for i in range(3):
+            src.train_batch(batch=batch_of(16, seed=i))
+        src.save_checkpoint(str(tmp_path / "ckpt"))
+        convert_checkpoint(str(tmp_path / "ckpt"), str(tmp_path / "uni"))
+
+        flat, meta = load_universal(str(tmp_path / "uni"))
+        assert meta["step"] == 3
+        assert any(k.startswith("params/") for k in flat)
+
+        # resume on a DIFFERENT topology (ZeRO-0, replicated) from universal
+        dst = _make_engine(dict(CONFIG), seed=99)
+        dst.load_checkpoint(str(tmp_path / "uni"), load_universal=True)
+        assert dst.global_steps == 3
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+                rtol=1e-6),
+            jax.device_get(src.state.params), jax.device_get(dst.state.params))
+
+        # training continues identically from either engine
+        la = float(src.train_batch(batch=batch_of(16, seed=7)))
+        lb = float(dst.train_batch(batch=batch_of(16, seed=7)))
+        assert abs(la - lb) < 1e-5
+
+    def test_config_flag_drives_universal_load(self, tmp_path):
+        src = _make_engine(dict(CONFIG))
+        src.train_batch(batch=batch_of(16))
+        src.save_checkpoint(str(tmp_path / "ckpt"))
+        convert_checkpoint(str(tmp_path / "ckpt"), str(tmp_path / "uni"))
+        dst = ds.initialize(
+            model=SimpleModel(),
+            config={**CONFIG, "checkpoint": {"load_universal": True}},
+            example_batch=batch_of(2), rng=jax.random.PRNGKey(5))[0]
+        dst.load_checkpoint(str(tmp_path / "uni"))
+        assert dst.global_steps == 1
+
+    def test_optimizer_mismatch_raises_unless_skipped(self, tmp_path):
+        src = _make_engine(dict(CONFIG))
+        src.train_batch(batch=batch_of(16))
+        src.save_checkpoint(str(tmp_path / "ckpt"))
+        convert_checkpoint(str(tmp_path / "ckpt"), str(tmp_path / "uni"))
+        dst = ds.initialize(
+            model=SimpleModel(),
+            config={**CONFIG, "optimizer": {"type": "Adagrad",
+                                            "params": {"lr": 1e-3}}},
+            example_batch=batch_of(2), rng=jax.random.PRNGKey(5))[0]
+        with pytest.raises((KeyError, ValueError)):
+            dst.load_checkpoint(str(tmp_path / "uni"), load_universal=True)
+        dst.load_checkpoint(str(tmp_path / "uni"), load_universal=True,
+                            load_optimizer_states=False)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+                rtol=1e-6),
+            jax.device_get(src.state.params), jax.device_get(dst.state.params))
