@@ -14,9 +14,16 @@ form is deliberately lower-tech, matching the reference's goal of a
 checkpoint anything can consume:
 
     <out_dir>/
-      universal_meta.json   {step, leaf paths -> shape/dtype, client_state}
-      state.npz             one fp32 entry per TrainState leaf, keyed by
+      universal_meta.json   {step, leaf paths -> shape/dtype/file, client_state}
+      leaves/NNNN__<name>.npy   ONE fp32 file per TrainState leaf, keyed by
                             "params/<path>" / "opt_state/<path>" flat names
+
+One file per leaf is the same layout decision the reference makes (one file
+per parameter) and for the same reason: an 8B-param fp32 master+moments state
+is ~100 GB — it must stream through bounded host memory on save and load,
+never materializing as one dict/archive. Leaves are written one at a time on
+save and memory-mapped on load. (The v1 single-``state.npz`` format is still
+readable.)
 
 Loading maps entries back by NAME onto the target engine's TrainState and
 ``device_put``s each leaf straight into its shard — so a universal
@@ -26,7 +33,9 @@ chip, or a differently-meshed pod without any reshape pass.
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+from collections.abc import Mapping
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
@@ -47,44 +56,84 @@ def _flat_name(kp) -> str:
     return "/".join(parts)
 
 
-def _flatten_state(state) -> Dict[str, np.ndarray]:
-    flat = {}
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", name)[:160]
+
+
+def _iter_leaves(state) -> Iterator[Tuple[str, Any]]:
     for kp, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
         if leaf is None:
             continue
-        arr = np.asarray(jax.device_get(leaf))
-        if arr.dtype == jax.numpy.bfloat16:
-            arr = arr.astype(np.float32)  # universal = plain-numpy readable
-        flat[_flat_name(kp)] = arr
-    return flat
+        yield _flat_name(kp), leaf
+
+
+def _to_host_fp32(leaf) -> np.ndarray:
+    arr = np.asarray(jax.device_get(leaf))
+    if arr.dtype == jax.numpy.bfloat16:
+        arr = arr.astype(np.float32)  # universal = plain-numpy readable
+    return arr
 
 
 def save_universal(state, out_dir: str, client_state: Optional[Dict] = None,
                    step: Optional[int] = None) -> None:
-    """Write a TrainState (or any pytree) as a universal checkpoint."""
-    os.makedirs(out_dir, exist_ok=True)
-    flat = _flatten_state(state)
-    np.savez(os.path.join(out_dir, "state.npz"), **flat)
+    """Write a TrainState (or any pytree) as a universal checkpoint.
+
+    Streams one leaf at a time: peak host memory is O(largest leaf), not
+    O(total state) — required for the 8B-class models the reference's
+    one-file-per-param layout targets.
+    """
+    leaf_dir = os.path.join(out_dir, "leaves")
+    os.makedirs(leaf_dir, exist_ok=True)
+    leaves_meta = {}
+    for name, leaf in _iter_leaves(state):
+        arr = _to_host_fp32(leaf)
+        fname = f"{len(leaves_meta):04d}__{_sanitize(name)}.npy"
+        np.save(os.path.join(leaf_dir, fname), arr)
+        leaves_meta[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                             "file": os.path.join("leaves", fname)}
+        del arr
     meta = {
-        "format": "deepspeed_tpu_universal_v1",
+        "format": "deepspeed_tpu_universal_v2",
         "step": int(step) if step is not None else None,
-        "leaves": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                   for k, v in flat.items()},
+        "leaves": leaves_meta,
         "client_state": client_state or {},
     }
     with open(os.path.join(out_dir, "universal_meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
 
 
-def load_universal(universal_dir: str) -> Tuple[Dict[str, np.ndarray], Dict]:
-    """Raw (flat state dict, meta) from a universal checkpoint dir."""
+class LazyLeafDict(Mapping):
+    """name -> np.ndarray, loaded lazily (mmap for v2 per-leaf files) so a
+    restore streams through bounded host memory."""
+
+    def __init__(self, universal_dir: str, meta: Dict):
+        self._dir = universal_dir
+        self._meta = meta
+        self._npz = None  # v1 back-compat: one state.npz archive
+        if "file" not in next(iter(meta["leaves"].values()), {"file": None}) \
+                or meta.get("format") == "deepspeed_tpu_universal_v1":
+            self._npz = np.load(os.path.join(universal_dir, "state.npz"))
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if self._npz is not None:
+            return self._npz[name]
+        rel = self._meta["leaves"][name]["file"]
+        return np.load(os.path.join(self._dir, rel), mmap_mode="r")
+
+    def __iter__(self):
+        return iter(self._meta["leaves"])
+
+    def __len__(self):
+        return len(self._meta["leaves"])
+
+
+def load_universal(universal_dir: str) -> Tuple[Mapping, Dict]:
+    """(lazy flat state dict, meta) from a universal checkpoint dir."""
     with open(os.path.join(universal_dir, "universal_meta.json")) as f:
         meta = json.load(f)
-    if meta.get("format") != "deepspeed_tpu_universal_v1":
+    if not str(meta.get("format", "")).startswith("deepspeed_tpu_universal_v"):
         raise ValueError(f"{universal_dir} is not a universal checkpoint")
-    with np.load(os.path.join(universal_dir, "state.npz")) as z:
-        flat = {k: z[k] for k in z.files}
-    return flat, meta
+    return LazyLeafDict(universal_dir, meta), meta
 
 
 def restore_into(template_state, state_shardings, universal_dir: str,
@@ -95,10 +144,16 @@ def restore_into(template_state, state_shardings, universal_dir: str,
     parallelism of the writing run is irrelevant (the reference's universal
     loader re-partitions by pattern for the same reason,
     ``engine.py:740`` + per-param universal files).
+
+    Shardings are matched to template leaves by NAME (not by zipped flatten
+    order): the two trees may disagree about where ``None`` appears (e.g.
+    ``loss_scale=None`` in a bf16 run), and positional zipping would silently
+    shift every subsequent leaf onto the wrong sharding.
     """
     flat, meta = load_universal(universal_dir)
+    shard_by_name = {name: s for name, s in _iter_leaves(state_shardings)}
 
-    def build(kp, leaf, sharding):
+    def build(kp, leaf):
         name = _flat_name(kp)
         if leaf is None:
             return None
@@ -113,15 +168,13 @@ def restore_into(template_state, state_shardings, universal_dir: str,
         if tuple(src.shape) != tuple(leaf.shape):
             raise ValueError(f"shape mismatch for {name}: checkpoint "
                              f"{src.shape} vs engine {leaf.shape}")
-        return jax.device_put(src.astype(leaf.dtype), sharding)
+        sharding = shard_by_name.get(name)
+        if sharding is None:
+            raise KeyError(f"no sharding for leaf {name!r} in state_shardings")
+        return jax.device_put(np.asarray(src, dtype=leaf.dtype), sharding)
 
-    leaves = [
-        build(kp, leaf, sharding)
-        for (kp, leaf), sharding in zip(
-            jax.tree_util.tree_flatten_with_path(template_state)[0],
-            jax.tree_util.tree_leaves(
-                state_shardings, is_leaf=lambda x: x is None))
-    ]
+    leaves = [build(kp, leaf) for kp, leaf in
+              jax.tree_util.tree_flatten_with_path(template_state)[0]]
     restored = jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(template_state), leaves)
     return restored, meta
@@ -131,13 +184,12 @@ def convert_checkpoint(ckpt_dir: str, out_dir: str,
                        tag: Optional[str] = None) -> None:
     """Offline: engine checkpoint directory → universal directory (the
     ``ds_to_universal`` CLI body; no engine or device mesh required)."""
-    import orbax.checkpoint as ocp
+    from .engine import load_pytree
 
     if tag is None:
         with open(os.path.join(ckpt_dir, "latest")) as f:
             tag = f.read().strip()
-    raw = ocp.StandardCheckpointer().restore(
-        os.path.abspath(os.path.join(ckpt_dir, tag)))
+    raw = load_pytree(os.path.join(ckpt_dir, tag))
     client_state = {}
     cs_path = os.path.join(ckpt_dir, f"{tag}.client_state.json")
     if os.path.exists(cs_path):
@@ -152,7 +204,7 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         description="Convert a deepspeed_tpu training checkpoint to the "
-                    "universal (topology-agnostic npz) format")
+                    "universal (topology-agnostic per-leaf npy) format")
     ap.add_argument("checkpoint_dir")
     ap.add_argument("output_dir")
     ap.add_argument("--tag", default=None)

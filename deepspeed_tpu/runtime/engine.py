@@ -353,6 +353,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             self.sparse_tensor_module_names, step_fn = \
                 build_sparse_dp_step(self)
             self._train_step_fn = step_fn
+            self._sparse_skip_mark = 0  # stall guard, see train_batch
             self._train_step = jax.jit(
                 step_fn,
                 in_shardings=(self.state_shardings, None, self._replicated),
@@ -742,6 +743,20 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
+        if self._config.sparse_gradients_enabled and self.global_steps % 16 == 0:
+            # sparse capacity overflows skip the step but (unlike fp16 loss
+            # scaling) never self-heal: if EVERY step of the window was
+            # skipped, training is stalled — fail loudly (the reference torch
+            # path errors on the sparse+dense grad mix; see sparse_engine)
+            skipped = self.get_skipped_steps()
+            if skipped - self._sparse_skip_mark >= 16:
+                raise RuntimeError(
+                    "sparse_gradients: the last 16 optimizer steps were ALL "
+                    "skipped by sparse-capacity overflow — an embedding in "
+                    "the sparse set receives dense gradients (tied embedding"
+                    "/vocab projection?). Disable sparse_gradients or untie "
+                    "the offending leaf.")
+            self._sparse_skip_mark = skipped
         self.tput_timer.stop()
         if self.wall_clock_breakdown:
             self.timers("train_batch").stop()
@@ -946,6 +961,27 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             client_state = meta.get("client_state", {})
             self.global_steps = int(client_state.get("global_steps",
                                                      meta.get("step") or 0))
+            if self._offload:
+                # universal checkpoints carry no host-optimizer banks: rebuild
+                # the fp32 masters straight from the checkpoint's fp32 arrays
+                # (NOT the bf16 device params — that would launder the master
+                # through 8 mantissa bits) and reset moments + step count
+                from ..checkpoint.universal import _flat_name, load_universal
+
+                flat, _ = load_universal(load_dir)
+                leaves = []
+                for kp, leaf in jax.tree_util.tree_flatten_with_path(
+                        state.params)[0]:
+                    name = "params/" + _flat_name(kp)
+                    leaves.append(
+                        np.asarray(flat[name], np.float32) if name in flat
+                        else np.asarray(jax.device_get(leaf), np.float32))
+                self._host_opt.reset_optimizer_state(leaves)
+                log_dist("[load_checkpoint] universal restore on an offload "
+                         "engine: fp32 masters copied from the checkpoint, "
+                         "optimizer moments reset", ranks=[0])
+            if hasattr(self, "_sparse_skip_mark"):
+                self._sparse_skip_mark = self.get_skipped_steps()
             return load_dir, client_state
         from ..checkpoint.engine import load_train_state
 
@@ -971,10 +1007,12 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 })
             else:
                 # no host state to restore: rebuild masters from the loaded
-                # device params so the next step doesn't clobber them
-                leaves = jax.tree_util.tree_leaves(jax.device_get(state.params))
-                for dst, src in zip(self._host_opt.master, leaves):
-                    np.copyto(dst, np.asarray(src, np.float32).ravel())
+                # device params (best source this checkpoint has) and reset
+                # moments so the next step doesn't apply stale state
+                self._host_opt.reset_optimizer_state(
+                    jax.tree_util.tree_leaves(jax.device_get(state.params)))
+        if hasattr(self, "_sparse_skip_mark"):
+            self._sparse_skip_mark = self.get_skipped_steps()
         return load_dir, client_state
 
 

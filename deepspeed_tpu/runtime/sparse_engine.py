@@ -51,6 +51,47 @@ def find_sparse_leaves(params) -> set:
     return names
 
 
+def probe_dense_sparse_leaves(engine, sparse_names: set) -> set:
+    """One real gradient evaluation on the engine's example batch; returns the
+    sparse-eligible leaves whose gradient is DENSE (touches more rows than the
+    batch has tokens) — the tied-embedding / vocab-projection case.
+
+    Such a leaf can never fit the static token-capacity row slices, so every
+    runtime step would overflow and be skipped: training silently stalls. The
+    reference's torch path fails loudly on the sparse+dense autograd mix
+    (sparse embedding grads cannot be added to the dense matmul grad); this
+    probe is the static-shape equivalent — detect at init, exclude the leaf
+    from the sparse set (it takes the dense pmean path), and warn.
+    """
+    if not sparse_names or engine.example_batch is None:
+        return set()
+    from ..utils.logging import log_dist
+
+    local_loss = make_local_loss(engine)
+    batch = {k: jnp.asarray(v) for k, v in engine.example_batch.items()}
+    tokens = max([int(np.prod(x.shape))
+                  for x in jax.tree_util.tree_leaves(batch)
+                  if jnp.issubdtype(x.dtype, jnp.integer)] or [0])
+    if tokens == 0:
+        return set()
+    rng = jax.random.PRNGKey(0)
+    grads = jax.grad(lambda p: local_loss(p, batch, rng))(engine.state.params)
+    dense = set()
+    for kp, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        if path not in sparse_names or tokens >= g.shape[0]:
+            continue
+        touched = int(jnp.sum(jnp.any(g != 0, axis=tuple(range(1, g.ndim)))))
+        if touched > tokens:
+            dense.add(path)
+    if dense:
+        log_dist(f"sparse_gradients: excluding dense-writing embedding leaves "
+                 f"{sorted(dense)} (tied embedding / vocab projection — their "
+                 f"gradient touches every row; they take the dense allreduce "
+                 f"path instead)", ranks=[0])
+    return dense
+
+
 def build_sparse_dp_step(engine):
     """Returns (sparse_leaf_names, train_step_fn) with the engine's compiled
     step contract: ``train_step(state, batch, rng) -> (state, (loss,
@@ -58,10 +99,12 @@ def build_sparse_dp_step(engine):
     mesh = engine.mesh
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     if shape.get("model", 1) != 1 or shape.get("seq", 1) != 1 or \
-            shape.get("pipe", 1) != 1:
-        raise ValueError("sparse_gradients is a pure-DP path: model/seq/pipe "
-                         "mesh axes must be 1 (reference restriction: sparse "
-                         "allreduce runs over the dp group only)")
+            shape.get("pipe", 1) != 1 or shape.get("expert", 1) != 1:
+        raise ValueError("sparse_gradients is a pure-DP path: model/seq/pipe/"
+                         "expert mesh axes must be 1 (reference restriction: "
+                         "sparse allreduce runs over the dp group only; "
+                         "expert-sharded params would break the replicated-"
+                         "param pmean exchange)")
     if engine._config.zero_optimization_stage != 0:
         raise ValueError("sparse_gradients requires ZeRO stage 0 (the "
                          "reference's ZeRO optimizers reject sparse grads)")
@@ -74,10 +117,11 @@ def build_sparse_dp_step(engine):
                          "quantize_training, progressive_layer_drop, or "
                          "compression_training")
 
-    axes = tuple(a for a in ("data", "expert") if shape.get(a, 1) > 1) or ("data",)
-    axis_tuple = axes if len(axes) > 1 else axes[0]
+    axes = ("data",)
+    axis_tuple = axes[0]
 
     sparse_names = find_sparse_leaves(engine.state.params)
+    sparse_names -= probe_dense_sparse_leaves(engine, sparse_names)
     optimizer = engine.optimizer
     gas = engine.gradient_accumulation_steps
     local_loss = make_local_loss(engine)

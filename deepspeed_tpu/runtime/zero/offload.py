@@ -227,6 +227,27 @@ class HostOffloadOptimizer:
             moments = self._moments
         return {"step": self.step_count, "master": self.master, "moments": moments}
 
+    def reset_optimizer_state(self, master_leaves=None):
+        """Fresh-optimizer reset: zero every moment bank and the step count;
+        optionally overwrite the fp32 masters from ``master_leaves``
+        (tree_leaves order, any float dtype — e.g. the exact fp32 arrays of a
+        universal checkpoint, so master precision is not laundered through
+        bf16 device params)."""
+        if master_leaves is not None:
+            for dst, src in zip(self.master, master_leaves):
+                np.copyto(dst, np.asarray(src, np.float32).ravel())
+        self.step_count = 0
+        if hasattr(self._opt, "step_count"):
+            self._opt.step_count = 0
+        for bank in self._moments:
+            for li in range(len(bank)):
+                if bank[li] is None:  # nvme: buffer currently spilled
+                    bank[li] = np.zeros(self.master[li].size, np.float32)
+                else:
+                    bank[li].fill(0.0)
+        if self._nvme_dir is not None:
+            self._spill_all()
+
     def load_state_dict(self, sd: Dict):
         self.step_count = int(sd["step"])
         for dst, src in zip(self.master, sd["master"]):

@@ -146,16 +146,43 @@ class TestSparseEngine:
         # dense exchange would be vocab*hidden*4 bytes
         assert sparse_bytes < 512 * 16 * 4
 
-    def test_tied_embedding_skips_not_corrupts(self):
+    def test_tied_embedding_excluded_and_progresses(self):
+        # the tied table's grad is dense; the init-time probe must detect it,
+        # route it through the dense allreduce, and training must PROGRESS
+        # (round-2 behavior skipped every step silently)
         rs = np.random.RandomState(0)
         batch = {"ids": rs.randint(0, 40, (16, 8))}
-        engine, _ = _train(
-            TiedEmbedModel(), {**BASE_CONFIG, "sparse_gradients": True},
-            batch, steps=2)
-        # the tied table's grad is dense -> capacity overflow -> every step
-        # skipped, params unchanged (never silently truncated)
-        assert int(jax.device_get(engine.state.skipped_steps)) == 2
-        assert int(jax.device_get(engine.state.step)) == 0
+        engine, *_ = ds.initialize(
+            model=TiedEmbedModel(),
+            config={**BASE_CONFIG, "sparse_gradients": True},
+            example_batch={k: v[:2] for k, v in batch.items()},
+            rng=jax.random.PRNGKey(7))
+        assert engine.sparse_tensor_module_names == set()
+        first = float(engine.train_batch(batch=batch))
+        for _ in range(4):
+            last = float(engine.train_batch(batch=batch))
+        assert int(jax.device_get(engine.state.skipped_steps)) == 0
+        assert int(jax.device_get(engine.state.step)) == 5
+        assert last < first
+
+    def test_stall_guard_raises_when_every_step_skipped(self, monkeypatch):
+        # defense in depth: if the dense-leaf probe ever misses (simulated by
+        # disabling it), 16 consecutive capacity-overflow skips must raise
+        # instead of silently training nowhere
+        from deepspeed_tpu.runtime import sparse_engine
+
+        monkeypatch.setattr(sparse_engine, "probe_dense_sparse_leaves",
+                            lambda engine, names: set())
+        rs = np.random.RandomState(0)
+        batch = {"ids": rs.randint(0, 40, (16, 8))}
+        engine, *_ = ds.initialize(
+            model=TiedEmbedModel(),
+            config={**BASE_CONFIG, "sparse_gradients": True},
+            example_batch={k: v[:2] for k, v in batch.items()},
+            rng=jax.random.PRNGKey(7))
+        with pytest.raises(RuntimeError, match="ALL +skipped|were ALL"):
+            for _ in range(16):
+                engine.train_batch(batch=batch)
 
     def test_rejects_zero_stage(self):
         batch = _embed_batch()
