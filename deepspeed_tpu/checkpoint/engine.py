@@ -33,8 +33,12 @@ def _checkpointer(kind: str):
     if kind not in _CKPTRS:
         import atexit
 
-        ckptr = (ocp.StandardCheckpointer() if kind == "sync"
-                 else ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler()))
+        if kind == "sync":
+            ckptr = ocp.StandardCheckpointer()
+        elif kind == "numpy":
+            ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        else:
+            ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         atexit.register(ckptr.close)
         _CKPTRS[kind] = ckptr
     return _CKPTRS[kind]
@@ -112,6 +116,25 @@ def save_pytree(path: str, tree: Any) -> None:
 def load_pytree(path: str, abstract_state: Any = None) -> Any:
     """Load a bare pytree (e.g. inference params)."""
     return OrbaxCheckpointEngine().load(path, abstract_state=abstract_state)
+
+
+def load_pytree_numpy(path: str) -> Any:
+    """Restore a checkpoint as HOST numpy arrays, ignoring the device mesh it
+    was saved from — no mesh (or even accelerator) required in this process.
+
+    The offline path for universal-checkpoint conversion and the elastic
+    agent: a state saved from any multi-process mesh must be readable by a
+    single CPU-only supervisor process (orbax's default restore refuses when
+    the saved device ids don't exist here)."""
+    import numpy as np
+
+    ckptr = _checkpointer("numpy")
+    meta = ckptr.metadata(os.path.abspath(path))
+    item = meta.item_metadata if hasattr(meta, "item_metadata") else meta
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item)
+    return ckptr.restore(os.path.abspath(path),
+                         args=ocp.args.PyTreeRestore(restore_args=restore_args))
 
 
 # ---------------------------------------------------------------------------

@@ -184,12 +184,12 @@ def convert_checkpoint(ckpt_dir: str, out_dir: str,
                        tag: Optional[str] = None) -> None:
     """Offline: engine checkpoint directory → universal directory (the
     ``ds_to_universal`` CLI body; no engine or device mesh required)."""
-    from .engine import load_pytree
+    from .engine import load_pytree_numpy
 
     if tag is None:
         with open(os.path.join(ckpt_dir, "latest")) as f:
             tag = f.read().strip()
-    raw = load_pytree(os.path.join(ckpt_dir, tag))
+    raw = load_pytree_numpy(os.path.join(ckpt_dir, tag))
     client_state = {}
     cs_path = os.path.join(ckpt_dir, f"{tag}.client_state.json")
     if os.path.exists(cs_path):

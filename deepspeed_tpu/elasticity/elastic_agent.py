@@ -1,0 +1,266 @@
+"""Runtime elastic agent: watch workers, respawn on failure, auto-resume.
+
+Counterpart of ``DSElasticAgent`` (reference
+``deepspeed/elasticity/elastic_agent.py:23`` — subclasses torch-elastic's
+``LocalElasticAgent``: ``_start_workers`` :52 sets the DeepSpeed env and the
+``_invoke_run`` health loop restarts the group when a worker dies).
+
+TPU-native shape: there is no torch-elastic rendezvous to ride — the agent
+IS the per-node supervisor. It owns three loops of the reference agent:
+
+1. **Failure detection** — poll the worker processes; any non-zero exit
+   tears the incarnation down (same fail-fast the plain launcher does).
+2. **Resize** — between incarnations the world size may change: repeated
+   failures at one size shrink to the next smaller count in the elastic
+   compatibility set (``compute_elastic_config`` — the batch/device math the
+   reference pre-agrees so hyperparameters survive the resize).
+3. **Resume** — before respawning, the latest engine checkpoint is converted
+   to a UNIVERSAL checkpoint (topology-agnostic, one fp32 file per leaf) and
+   workers get ``DS_ELASTIC_CHECKPOINT_DIR``; the engine auto-saves there
+   periodically and auto-restores on init, so the restarted job continues
+   from the last completed save at the new world size.
+
+The conversion runs in the agent process (no device mesh needed), exactly
+between incarnations — the one moment the topology is allowed to change.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
+
+# env contract with the engine (runtime/engine.py reads these)
+CHECKPOINT_DIR_ENV = "DS_ELASTIC_CHECKPOINT_DIR"
+RESTART_COUNT_ENV = "DS_ELASTIC_RESTART_COUNT"
+UNIVERSAL_SUBDIR = "elastic_universal"
+
+
+def latest_universal_dir(checkpoint_dir: str) -> Optional[str]:
+    path = os.path.join(checkpoint_dir, UNIVERSAL_SUBDIR)
+    return path if os.path.exists(os.path.join(path, "universal_meta.json")) \
+        else None
+
+
+class ElasticAgent:
+    """Single-node supervisor (the per-node role of the reference agent;
+    multinode elasticity = one agent per node behind the SSH runner)."""
+
+    def __init__(self, script: str, script_args: List[str], nproc: int,
+                 checkpoint_dir: str, ds_config: Optional[Dict] = None,
+                 coordinator_port: int = 29500, cpu_devices_per_proc: int = 0,
+                 max_restarts: int = 3, min_procs: int = 1,
+                 env: Optional[Dict[str, str]] = None,
+                 convert_timeout_s: float = 600.0):
+        self.script = script
+        self.script_args = list(script_args)
+        self.nproc = nproc
+        self.checkpoint_dir = checkpoint_dir
+        self.ds_config = ds_config
+        self.coordinator_port = coordinator_port
+        self.cpu_devices_per_proc = cpu_devices_per_proc
+        self.max_restarts = max_restarts
+        self.min_procs = min_procs
+        self.extra_env = dict(env or {})
+        self.convert_timeout_s = convert_timeout_s
+
+    # -- world-size policy -------------------------------------------------
+
+    def _valid_counts(self) -> Optional[List[int]]:
+        if not (self.ds_config or {}).get("elasticity", {}).get("enabled"):
+            return None
+        try:
+            return compute_elastic_config(self.ds_config).valid_gpus
+        except ElasticityIncompatibleWorldSize:  # pragma: no cover
+            return None
+
+    def next_world_size(self, current: int, consecutive_failures: int) -> int:
+        """Same size on a first failure (transient crash); shrink to the next
+        smaller compatible count on repeated failure at one size (the
+        reference agent re-rendezvouses with however many workers remain —
+        here shrinking is the single-node analog of a lost worker)."""
+        if consecutive_failures < 2:
+            return current
+        valid = self._valid_counts()
+        candidates = ([c for c in valid if c < current] if valid
+                      else list(range(self.min_procs, current)))
+        return max(candidates) if candidates else current
+
+    # -- incarnation -------------------------------------------------------
+
+    def _spawn(self, nproc: int, restart_count: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--nproc_per_node={nproc}", "--nnodes=1", "--node_rank=0",
+               f"--coordinator=127.0.0.1:{self.coordinator_port}"]
+        if self.cpu_devices_per_proc:
+            cmd.append(f"--cpu_devices_per_proc={self.cpu_devices_per_proc}")
+        cmd += [self.script] + self.script_args
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env[CHECKPOINT_DIR_ENV] = self.checkpoint_dir
+        env[RESTART_COUNT_ENV] = str(restart_count)
+        # own session: lets the agent SIGKILL the whole worker tree between
+        # incarnations so no survivor holds the coordinator port / chips
+        return subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    @staticmethod
+    def _reap(proc: subprocess.Popen) -> None:
+        import signal as _signal
+
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
+
+    def _convert_latest(self) -> Optional[str]:
+        """Latest engine checkpoint → universal dir; None if no save yet or
+        the conversion failed.
+
+        Runs in a CPU-platform subprocess: the conversion is host-side numpy
+        work, and the agent must never block on accelerator init (the whole
+        point of the agent is surviving a sick accelerator/backend). Writes
+        into a temp dir and renames into place so a killed conversion can
+        never leave a mixed-step checkpoint behind."""
+        import shutil
+
+        latest = os.path.join(self.checkpoint_dir, "latest")
+        if not os.path.exists(latest):
+            return None
+        out = os.path.join(self.checkpoint_dir, UNIVERSAL_SUBDIR)
+        tmp = out + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        src = ("import jax\n"
+               "jax.config.update('jax_platforms', 'cpu')\n"
+               "from deepspeed_tpu.checkpoint.universal import convert_checkpoint\n"
+               f"convert_checkpoint({self.checkpoint_dir!r}, {tmp!r})\n")
+        try:
+            r = subprocess.run([sys.executable, "-c", src],
+                               capture_output=True, text=True,
+                               timeout=self.convert_timeout_s)
+            ok, why = r.returncode == 0, (r.stderr or "")[-2000:]
+        except subprocess.TimeoutExpired:
+            ok, why = False, f"timeout after {self.convert_timeout_s:.0f}s"
+        if not ok:
+            print(f"elastic-agent: checkpoint conversion failed: {why}",
+                  file=sys.stderr)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        old = out + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.exists(out):
+            os.rename(out, old)
+        os.rename(tmp, out)
+        shutil.rmtree(old, ignore_errors=True)
+        return out
+
+    def _quarantine_stale_universal(self) -> None:
+        """A universal checkpoint OLDER than the newest engine save must not
+        drive auto-resume (it would silently roll training back past
+        completed, checkpointed work): move it aside so workers either get a
+        fresh conversion or start from the engine state they can reach."""
+        import shutil
+
+        uni = latest_universal_dir(self.checkpoint_dir)
+        latest = os.path.join(self.checkpoint_dir, "latest")
+        if uni is None or not os.path.exists(latest):
+            return
+        try:
+            with open(os.path.join(uni, "universal_meta.json")) as f:
+                uni_step = int(json.load(f).get("step") or 0)
+            with open(latest) as f:
+                tag = f.read().strip()
+            latest_step = int(tag.rsplit("global_step", 1)[-1])
+        except (ValueError, OSError):
+            return
+        if uni_step < latest_step:
+            print(f"elastic-agent: universal checkpoint (step {uni_step}) is "
+                  f"older than the newest engine save (step {latest_step}); "
+                  f"quarantining it rather than silently rolling back",
+                  file=sys.stderr)
+            shutil.rmtree(uni + ".stale", ignore_errors=True)
+            os.rename(uni, uni + ".stale")
+
+    # -- the health loop ---------------------------------------------------
+
+    def run(self) -> int:
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        nproc = self.nproc
+        restarts = 0
+        consecutive = 0
+        while True:
+            valid = self._valid_counts()
+            if valid and nproc not in valid:
+                compatible = [c for c in valid if c <= nproc]
+                if not compatible:
+                    print(f"elastic-agent: no compatible world size <= {nproc}",
+                          file=sys.stderr)
+                    return 1
+                nproc = max(compatible)
+            print(f"elastic-agent: incarnation {restarts}: {nproc} workers",
+                  file=sys.stderr, flush=True)
+            proc = self._spawn(nproc, restarts)
+            rc = proc.wait()
+            if rc == 0:
+                return 0
+            self._reap(proc)  # the rest of the incarnation's tree, hard
+            restarts += 1
+            consecutive += 1
+            if restarts > self.max_restarts:
+                print(f"elastic-agent: giving up after {self.max_restarts} "
+                      f"restarts (last rc={rc})", file=sys.stderr)
+                return rc
+            uni = self._convert_latest()
+            if uni is None:
+                # retry once (transient IO), then refuse a stale resume
+                uni = self._convert_latest()
+            if uni is None:
+                self._quarantine_stale_universal()
+                uni = latest_universal_dir(self.checkpoint_dir)
+            new_nproc = self.next_world_size(nproc, consecutive)
+            if new_nproc != nproc:
+                consecutive = 0
+            print(f"elastic-agent: worker group failed (rc={rc}); "
+                  f"resuming {'from ' + uni if uni else 'from scratch'} "
+                  f"at {new_nproc} workers", file=sys.stderr, flush=True)
+            nproc = new_nproc
+            time.sleep(2.0)  # let the coordinator port drain
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="deepspeed_tpu elastic agent (reference: DSElasticAgent)")
+    ap.add_argument("--num_procs", type=int, required=True)
+    ap.add_argument("--checkpoint_dir", required=True)
+    ap.add_argument("--ds_config", default=None,
+                    help="JSON config with an elasticity block (drives the "
+                         "compatible-world-size set)")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--min_procs", type=int, default=1)
+    ap.add_argument("--coordinator_port", type=int, default=29500)
+    ap.add_argument("--cpu_devices_per_proc", type=int, default=0)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs="*")
+    args = ap.parse_args(argv)
+    ds_config = None
+    if args.ds_config:
+        with open(args.ds_config) as f:
+            ds_config = json.load(f)
+    agent = ElasticAgent(
+        args.script, args.script_args, args.num_procs, args.checkpoint_dir,
+        ds_config=ds_config, coordinator_port=args.coordinator_port,
+        cpu_devices_per_proc=args.cpu_devices_per_proc,
+        max_restarts=args.max_restarts, min_procs=args.min_procs)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,18 @@ def parse_args(argv=None):
     p.add_argument("--ssh_args", default="", help="extra ssh flags")
     p.add_argument("--env_passthrough", default="PYTHONPATH,JAX_PLATFORMS",
                    help="comma list of env vars exported to remote nodes")
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers with the elastic agent: on worker "
+                        "failure, respawn (possibly at a smaller compatible "
+                        "world size) and auto-resume from the latest "
+                        "checkpoint (reference: DSElasticAgent)")
+    p.add_argument("--elastic_checkpoint_dir", default="elastic_checkpoints",
+                   help="agent checkpoint dir (engine auto-saves here)")
+    p.add_argument("--elastic_ds_config", default=None,
+                   help="JSON config with an elasticity block; drives the "
+                        "compatible-world-size set on resize")
+    p.add_argument("--max_elastic_restarts", type=int, default=3)
+    p.add_argument("--min_elastic_procs", type=int, default=1)
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -177,6 +189,27 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.script_args and args.script_args[0] == "--":
         args.script_args = args.script_args[1:]
+
+    if args.elastic:
+        if args.hostfile is not None:
+            raise SystemExit("--elastic is single-node for now: run one "
+                             "agent per node behind your scheduler")
+        import json as _json
+
+        from ..elasticity.elastic_agent import ElasticAgent
+
+        ds_config = None
+        if args.elastic_ds_config:
+            with open(args.elastic_ds_config) as f:
+                ds_config = _json.load(f)
+        agent = ElasticAgent(
+            args.script, list(args.script_args), args.num_procs or 1,
+            args.elastic_checkpoint_dir, ds_config=ds_config,
+            coordinator_port=args.coordinator_port,
+            cpu_devices_per_proc=args.cpu_devices_per_proc,
+            max_restarts=args.max_elastic_restarts,
+            min_procs=args.min_elastic_procs)
+        return agent.run()
 
     if args.hostfile is None:
         # single-node: in-process delegation to the per-node spawner

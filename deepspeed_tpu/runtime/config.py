@@ -150,6 +150,10 @@ class ElasticityConfig(DeepSpeedConfigModel):
     version: float = 0.1
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    # TPU-native addition: auto-save cadence (steps) under the elastic agent
+    # (env DS_ELASTIC_CHECKPOINT_DIR); reference workers checkpoint from the
+    # training script, here the engine owns it so resume is automatic
+    save_interval: int = 10
 
 
 class AutotuningConfig(DeepSpeedConfigModel):

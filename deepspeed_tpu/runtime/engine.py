@@ -376,6 +376,20 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self._pending_microbatches = []
         self._last_loss = None
 
+        # ---- elastic-agent contract (elasticity/elastic_agent.py) ------
+        # under the agent, auto-save periodically into its checkpoint dir
+        # and auto-resume from the universal checkpoint the agent converted
+        # between incarnations (reference DSElasticAgent restart semantics)
+        self._elastic_ckpt_dir = os.environ.get("DS_ELASTIC_CHECKPOINT_DIR")
+        if self._elastic_ckpt_dir:
+            from ..elasticity.elastic_agent import latest_universal_dir
+
+            uni = latest_universal_dir(self._elastic_ckpt_dir)
+            if uni is not None:
+                self.load_checkpoint(uni, load_universal=True)
+                log_dist(f"elastic auto-resume from {uni} at step "
+                         f"{self.global_steps}", ranks=[0])
+
         log_dist(f"DeepSpeedEngine initialized: precision={self._config.precision}, "
                  f"zero_stage={self._config.zero_optimization_stage}, "
                  f"dp={self.dp_world_size}, mp={self.mp_world_size}, "
@@ -757,6 +771,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                     "/vocab projection?). Disable sparse_gradients or untie "
                     "the offending leaf.")
             self._sparse_skip_mark = skipped
+        if self._elastic_ckpt_dir and self.global_steps % \
+                max(1, self._config.elasticity.save_interval) == 0:
+            self.save_checkpoint(self._elastic_ckpt_dir)
+            self._prune_elastic_checkpoints(keep=2)
         self.tput_timer.stop()
         if self.wall_clock_breakdown:
             self.timers("train_batch").stop()
@@ -768,6 +786,29 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             self._report_progress(loss)
         self._last_loss = loss
         return loss
+
+    def _prune_elastic_checkpoints(self, keep: int) -> None:
+        """The engine owns the elastic auto-save cadence, so it must also own
+        the disk: keep the newest ``keep`` global_step* snapshots (only
+        ``latest`` is ever converted/resumed by the agent)."""
+        if jax.process_index() != 0:
+            return
+        import re
+        import shutil
+
+        d = self._elastic_ckpt_dir
+        steps = []
+        for name in os.listdir(d):
+            m = re.fullmatch(r"global_step(\d+)", name)
+            if m and os.path.isdir(os.path.join(d, name)):
+                steps.append(int(m.group(1)))
+        for s in sorted(steps)[:-keep]:
+            shutil.rmtree(os.path.join(d, f"global_step{s}"),
+                          ignore_errors=True)
+            try:
+                os.remove(os.path.join(d, f"global_step{s}.client_state.json"))
+            except OSError:
+                pass
 
     def _print_flops_profile(self, shaped_batch, rng, step_time_s):
         """Flops-profiler hook (reference ``engine.py:1615,1634``: start at

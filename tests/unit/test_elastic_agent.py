@@ -1,0 +1,107 @@
+"""Elastic agent: failure detection -> respawn -> universal-checkpoint resume
+(reference ``deepspeed/elasticity/elastic_agent.py:23,52``)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestWorldSizePolicy:
+    def _agent(self, ds_config=None, min_procs=1):
+        return ElasticAgent("t.py", [], 4, "/tmp/na", ds_config=ds_config,
+                            min_procs=min_procs)
+
+    def test_first_failure_keeps_size(self):
+        assert self._agent().next_world_size(4, consecutive_failures=1) == 4
+
+    def test_repeat_failure_shrinks(self):
+        assert self._agent().next_world_size(4, consecutive_failures=2) == 3
+
+    def test_shrink_respects_elastic_compat_set(self):
+        cfg = {"elasticity": {"enabled": True, "micro_batch_sizes": [2, 4],
+                              "max_train_batch_size": 64, "min_gpus": 1,
+                              "max_gpus": 8}}
+        a = self._agent(ds_config=cfg)
+        nxt = a.next_world_size(4, consecutive_failures=2)
+        assert nxt in a._valid_counts() and nxt < 4
+
+    def test_shrink_floor(self):
+        assert self._agent(min_procs=2).next_world_size(
+            2, consecutive_failures=2) == 2
+
+
+@pytest.mark.slow
+def test_kill_worker_respawns_and_resumes(tmp_path):
+    """VERDICT r2 'done' criterion: kill-a-worker on the 2-process CPU
+    harness; the agent respawns the group and the run resumes at the correct
+    step from the auto-converted universal checkpoint."""
+    script = tmp_path / "train_elastic.py"
+    # incarnation 0: rank 1 SIGKILLs itself at step 6 (after the step-5
+    # auto-save). incarnation 1: auto-resume must land on step 5 and run to
+    # completion, writing a done-file with the final step and loss.
+    script.write_text(textwrap.dedent("""\
+        import json, os, signal
+        import numpy as np
+        import jax
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        restart = int(os.environ["DS_ELASTIC_RESTART_COUNT"])
+        cfg = LlamaConfig.tiny(remat=False)
+        model = LlamaForCausalLM(cfg)
+        rs = np.random.RandomState(0)
+        batch = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)),
+                 "labels": rs.randint(0, cfg.vocab_size, (8, 16))}
+        engine, *_ = ds.initialize(model=model,
+            config={"train_batch_size": 8,
+                    "elasticity": {"enabled": True,
+                                   "micro_batch_sizes": [1, 2, 4],
+                                   "max_train_batch_size": 8,
+                                   "min_gpus": 1, "max_gpus": 8,
+                                   "ignore_non_elastic_batch_info": True,
+                                   "save_interval": 5},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "steps_per_print": 0},
+            example_batch={k: v[:1] for k, v in batch.items()})
+        start_step = engine.global_steps
+        if restart == 0:
+            assert start_step == 0
+        else:
+            assert start_step == 5, f"resumed at {start_step}, want 5"
+        while engine.global_steps < 10:
+            loss = engine.train_batch(batch=batch)
+            if restart == 0 and engine.global_steps == 6 \\
+                    and jax.process_index() == 1:
+                os.kill(os.getpid(), signal.SIGKILL)
+        if jax.process_index() == 0:
+            with open(os.environ["DS_DONE_FILE"], "w") as f:
+                json.dump({"step": engine.global_steps,
+                           "start_step": start_step,
+                           "restart": restart,
+                           "loss": float(loss)}, f)
+        print("DONE", jax.process_index(), flush=True)
+        """))
+    done = tmp_path / "done.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DS_DONE_FILE"] = str(done)
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--elastic", "--num_procs", "2", "--cpu_devices_per_proc", "4",
+         "--elastic_checkpoint_dir", str(tmp_path / "eckpt"),
+         "--coordinator_port", "29741", str(script)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(done.read_text())
+    assert rec["step"] == 10
+    assert rec["start_step"] == 5      # resumed from the step-5 auto-save
+    assert rec["restart"] >= 1         # second incarnation finished the run
+    assert "incarnation 1" in out.stderr

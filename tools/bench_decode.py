@@ -1,17 +1,27 @@
 """Inference decode benchmark: TTFT + decode throughput on the real chip.
 
 Counterpart of the reference DS-Inference latency/throughput numbers
-(``docs/_posts/2021-05-05-inference-kernel-optimization.md``): measures
+(``docs/_posts/2021-05-05-inference-kernel-optimization.md:53-67``): measures
 time-to-first-token (prefill) and steady-state decode tokens/sec for the
 flagship Llama decode graph via ``init_inference`` (whole generation loop in
-one jit). Prints one JSON line per configuration.
+one jit), at several (batch, prompt) points.
 
-Usage: python tools/bench_decode.py [--tiny] [--batch B] [--prompt P] [--new N]
+Hardened like ``bench.py``: the parent probes the backend with a short
+deadline, runs every measurement point in a capped subprocess (shared compile
+cache), and ALWAYS prints one final JSON summary line on stdout —
+measurements when they exist, ``{"points": [], "error": ...}`` otherwise.
+Commit the output as ``DECODE_r{N}.json``.
+
+Usage:
+  python tools/bench_decode.py                 # sweep on the real chip
+  python tools/bench_decode.py --tiny          # CPU smoke (CI)
+  python tools/bench_decode.py --one B P N     # child: a single point
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -21,17 +31,14 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tiny", action="store_true", help="CPU smoke test")
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--prompt", type=int, default=512)
-    ap.add_argument("--new", type=int, default=128)
-    args = ap.parse_args()
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
 
+
+def run_point(batch: int, prompt: int, new: int, tiny: bool) -> dict:
     import jax
 
-    if args.tiny:
+    if tiny:
         # smoke mode must not wait on a real accelerator (env vars cannot
         # switch platforms here; the config route always works)
         jax.config.update("jax_platforms", "cpu")
@@ -39,19 +46,18 @@ def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    if args.tiny:
+    if tiny:
         cfg = LlamaConfig.tiny(remat=False)
-        args.prompt, args.new = 16, 8
     else:
         cfg = LlamaConfig.llama_400m(
-            max_position_embeddings=args.prompt + args.new, remat=False)
+            max_position_embeddings=prompt + new, remat=False)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
-    ids = rs.randint(0, cfg.vocab_size, (args.batch, args.prompt))
+    ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  jax.numpy.asarray(ids[:1]))["params"]
     engine = ds.init_inference(model, params=params, dtype="bf16",
-                               max_out_tokens=args.prompt + args.new)
+                               max_out_tokens=prompt + new)
 
     # TTFT: generation of ONE new token = prefill + single decode step
     np.asarray(engine.generate(ids, max_new_tokens=1))  # compile
@@ -62,23 +68,114 @@ def main():
     # decode throughput from the DIFFERENCE of two full runs (new vs 1 new
     # token): (new - 1) extra decode steps; avoids subtracting measurements
     # from differently-compiled programs' overheads
-    np.asarray(engine.generate(ids, max_new_tokens=args.new))  # compile
+    np.asarray(engine.generate(ids, max_new_tokens=new))  # compile
     t0 = time.perf_counter()
-    out = np.asarray(engine.generate(ids, max_new_tokens=args.new))
+    np.asarray(engine.generate(ids, max_new_tokens=new))
     dt = time.perf_counter() - t0
-    extra_steps = args.new - 1
-    decode_tps = (args.batch * extra_steps / (dt - ttft)
+    extra_steps = new - 1
+    decode_tps = (batch * extra_steps / (dt - ttft)
                   if extra_steps > 0 and dt > ttft else None)
 
-    print(json.dumps({
-        "metric": "llama400m_decode",
+    return {
         "ttft_ms": round(ttft * 1e3, 1),
         "decode_tokens_per_sec":
             round(decode_tps, 1) if decode_tps else None,
+        "per_seq_decode_ms_per_token":
+            round((dt - ttft) / extra_steps * 1e3, 2)
+            if extra_steps > 0 and dt > ttft else None,
         "end_to_end_s": round(dt, 3),
-        "batch": args.batch, "prompt": args.prompt, "new_tokens": args.new,
-    }))
+        "batch": batch, "prompt": prompt, "new_tokens": new,
+    }
+
+
+def _run_sub(extra_argv, timeout_s):
+    cmd = [sys.executable, os.path.abspath(__file__)] + extra_argv
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        for line in stderr.splitlines()[-10:]:
+            log(f"  | {line}")
+        return None, f"timeout after {timeout_s:.0f}s"
+    for line in r.stderr.splitlines():
+        log(f"  | {line}")
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["?"])[-1]
+        return None, f"rc={r.returncode}: {tail[:300]}"
+    out = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+    if not out:
+        return None, "no JSON on stdout"
+    try:
+        return json.loads(out[-1]), ""
+    except ValueError as e:
+        return None, f"bad JSON: {e}"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CPU smoke test")
+    ap.add_argument("--one", nargs=3, type=int, metavar=("B", "P", "N"),
+                    help="child mode: measure a single (batch,prompt,new) point")
+    args = ap.parse_args()
+
+    if args.one:
+        b, p, n = args.one
+        print(json.dumps(run_point(b, p, n, args.tiny)), flush=True)
+        return
+
+    probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
+    point_cap = float(os.environ.get("DS_BENCH_CANDIDATE_S",
+                                     "120" if args.tiny else "420"))
+    # latency point (bs=1), the reference-blog-like serving point, and a
+    # throughput point — TTFT + decode t/s at each
+    points = ([(1, 16, 8), (2, 16, 8)] if args.tiny
+              else [(1, 128, 128), (8, 512, 128), (32, 1024, 128)])
+
+    summary = {"metric": "llama400m_decode", "points": []}
+    if not args.tiny:
+        log(f"bench_decode: probing backend (deadline {probe_deadline:.0f}s)")
+        probe = ("import json, time\nt0 = time.time()\nimport jax\n"
+                 "d = jax.devices()\nprint(json.dumps({'n': len(d)}))\n")
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, text=True,
+                               timeout=probe_deadline)
+            ok = r.returncode == 0 and "{" in r.stdout
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            summary["error"] = "backend unavailable"
+            print(json.dumps(summary), flush=True)
+            return
+
+    errors = []
+    for b, p, n in points:
+        tag = f"b{b},p{p},n{n}"
+        log(f"bench_decode: point {tag} (cap {point_cap:.0f}s)")
+        argv = ["--one", str(b), str(p), str(n)] + (["--tiny"] if args.tiny else [])
+        rec, why = _run_sub(argv, point_cap)
+        if rec is None:
+            log(f"bench_decode: {tag} FAILED: {why}")
+            errors.append(f"{tag}: {why}")
+            continue
+        log(f"bench_decode: {tag}: TTFT {rec['ttft_ms']}ms, "
+            f"{rec['decode_tokens_per_sec']} decode tok/s")
+        summary["points"].append(rec)
+    if errors:
+        summary["error"] = "; ".join(errors)
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--one" in sys.argv:
+        main()  # child: failures must exit non-zero so the parent records
+                # them as point errors instead of parsing garbage
+    else:
+        try:
+            main()
+        except Exception as e:  # guaranteed JSON on any parent failure
+            print(json.dumps({"metric": "llama400m_decode", "points": [],
+                              "error": f"{type(e).__name__}: {e}"}), flush=True)
