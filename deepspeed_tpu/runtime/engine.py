@@ -199,7 +199,8 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         #: explicit wire-compressed 1-bit path (runtime/onebit_engine.py)
         self._onebit_wire = bool(
             opt_cfg is not None and not self._offload
-            and opt_cfg.type.lower() in ("onebitadam",)
+            and opt_cfg.type.lower() in ("onebitadam", "onebitlamb",
+                                         "zerooneadam")
             and (opt_cfg.params or {}).get("comm_backend_name") == "compressed")
         self.optimizer = None if (self._offload or self._onebit_wire) \
             else self._build_optimizer()
@@ -332,7 +333,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                     "optax 1-bit optimizers (no comm_backend_name)")
 
             opt_state, ob_shardings, step_fn = build_onebit_wire(
-                self, dict(opt_cfg.params or {}))
+                self, dict(opt_cfg.params or {}), kind=opt_cfg.type.lower())
             self.opt_shardings = ob_shardings
             self.state = self.state.replace(opt_state=jax.device_put(
                 opt_state, ob_shardings))
