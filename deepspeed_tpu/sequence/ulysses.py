@@ -50,9 +50,20 @@ def ulysses_attention(q, k, v, causal: bool = False, bias=None,
             f"Ulysses needs head count ({H}) divisible by seq*model axes "
             f"({sp}*{tp}); use attention_impl='ring' for this configuration")
 
+    # Inside a partial-manual shard_map (the pipeline ring: pipe/data/expert
+    # manual, seq/model auto) a sharding constraint may only name the AUTO
+    # axes — the manual ones are already per-device. Dropping them keeps the
+    # head<->seq reshard meaningful exactly where the partitioner acts.
+    manual = set(getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ()))
+
+    def free(axes):
+        kept = tuple(a for a in (axes if isinstance(axes, (tuple, list))
+                                 else (axes,)) if a not in manual)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+
     if sp > 1:
         # heads take over the seq shard: tokens become fully local per shard
-        head_spec = P(BATCH_AXES, None, ("model", "seq"), None)
+        head_spec = P(free(BATCH_AXES), None, free(("model", "seq")), None)
         q = jax.lax.with_sharding_constraint(q, jax.NamedSharding(mesh, head_spec))
         k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
         v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
@@ -68,7 +79,8 @@ def ulysses_attention(q, k, v, causal: bool = False, bias=None,
     if sp > 1:
         # back to token-sharded for the rest of the block
         out = jax.lax.with_sharding_constraint(
-            out, jax.NamedSharding(mesh, P(BATCH_AXES, "seq", "model", None)))
+            out, jax.NamedSharding(
+                mesh, P(free(BATCH_AXES), free("seq"), free("model"), None)))
     return out
 
 

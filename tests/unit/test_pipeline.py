@@ -437,3 +437,104 @@ def test_time_checkpoint_chunk_matches_plain_scan():
         example_batch={"inputs": ids, "labels": labels})
     assert e_auto.time_checkpoint_chunk >= 2
     assert np.isfinite(float(e_auto.train_batch(batch=batch)))
+
+
+class SelfAttnBlock(nn.Module):
+    """Tiny self-attention block whose attention reshards via Ulysses when a
+    ``seq`` mesh axis is present (used by the pipe x seq composition test)."""
+
+    hidden: int = 32
+    heads: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.sequence.ulysses import ulysses_attention
+
+        B, T, H = x.shape
+        d = self.hidden // self.heads
+        h = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * self.hidden, name="qkv")(h)
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * self.heads, d), 3, axis=2)
+        out = ulysses_attention(q, k, v, causal=True)
+        return x + nn.Dense(self.hidden, name="proj")(out.reshape(B, T, self.hidden))
+
+
+def test_pipeline_composes_with_sequence_parallel():
+    """pipe=2 x seq=2 (x data=2): Ulysses attention reshards over the AUTO
+    ``seq`` axis inside the manual pipe ring — parity vs sequential
+    (VERDICT r2 #5: lift the pipe x seq restriction)."""
+    from deepspeed_tpu.parallel import build_mesh, topology
+    from deepspeed_tpu.pipe import LayerSpec, PipelineModule
+    from deepspeed_tpu.pipe.engine import _pipeline_loss_fn
+
+    mesh = build_mesh(pipe=2, data=2, seq=2)
+    topology.set_mesh(mesh)
+    try:
+        pipe = PipelineModule(
+            layers=[LayerSpec(EmbedIn, hidden=32),
+                    *[LayerSpec(SelfAttnBlock) for _ in range(4)],
+                    LayerSpec(HeadOut)],
+            num_stages=2, loss_fn=ce_loss)
+        ids, labels = _data(B=16, T=8)
+        params = pipe.init_params(jax.random.PRNGKey(0), ids)
+
+        micro = 4
+        loss_fn = _pipeline_loss_fn(pipe, mesh, micro)
+        l_pipe, g_pipe = jax.jit(jax.value_and_grad(lambda p: loss_fn(
+            p, {"inputs": ids, "labels": labels}, None)[0]))(params)
+
+        mb = ids.shape[0] // micro
+
+        def seq_loss(p):
+            losses = [ce_loss(pipe.apply_sequential(p, ids[m * mb:(m + 1) * mb]),
+                              labels[m * mb:(m + 1) * mb])
+                      for m in range(micro)]
+            return jnp.mean(jnp.stack(losses))
+
+        l_seq, g_seq = jax.value_and_grad(seq_loss)(params)
+        np.testing.assert_allclose(float(l_pipe), float(l_seq), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6)
+    finally:
+        topology.set_mesh(None, None)
+
+
+def test_time_chunk_defaults_on_and_bounds_memory():
+    """VERDICT r2 #5: (a) time_checkpoint_chunk defaults to 'auto';
+    (b) the chunked-remat backward's temp memory is measurably smaller than
+    the plain scan's (compiled-program memory analysis on the CPU mesh)."""
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.pipe import PipelineEngine
+    from deepspeed_tpu.pipe.engine import _pipeline_loss_fn
+
+    # (a) default is on
+    pipe = make_module(2, n_blocks=4)
+    ids, labels = _data(B=32)
+    engine = PipelineEngine(
+        model=pipe,
+        config={"train_batch_size": 32, "gradient_accumulation_steps": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 0},
+        example_batch={"inputs": ids, "labels": labels},
+        mesh=build_mesh(pipe=2, data=4))
+    assert engine.time_checkpoint_chunk > 0  # auto-derived, not off
+
+    # (b) chunked backward allocates less temp than the plain scan
+    mesh = build_mesh(pipe=2, data=4)
+    pipe2 = make_module(2, n_blocks=6)
+    params = pipe2.init_params(jax.random.PRNGKey(0), ids)
+    micro = 16
+    ids16, labels16 = _data(B=64, T=16)
+
+    def temp_bytes(time_chunk):
+        loss_fn = _pipeline_loss_fn(pipe2, mesh, micro, time_chunk=time_chunk)
+        g = jax.jit(jax.grad(lambda p: loss_fn(
+            p, {"inputs": ids16, "labels": labels16}, None)[0]))
+        c = g.lower(params).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    plain = temp_bytes(0)
+    chunked = temp_bytes(4)
+    assert chunked < plain, (chunked, plain)
