@@ -1117,10 +1117,33 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                 f"initialize(model=PipelineModule) does not accept {bad}: the "
                 "pipeline module owns its params/loss/partitioning (use "
                 "engine.load_checkpoint to restore weights)")
-        engine = PipelineEngine(model=model, config=config, example_batch=example_batch,
-                                mesh=mesh, rng=rng, optimizer=optimizer,
-                                lr_scheduler=lr_scheduler,
-                                dist_init_required=dist_init_required)
+        cfg_dict = load_config_dict(config) or {}
+        from .zero.config import DeepSpeedZeroConfig
+
+        _zcfg = DeepSpeedZeroConfig(**(cfg_dict.get("zero_optimization") or {}))
+        if _zcfg.offload_param is not None and \
+                _zcfg.offload_param.device != "none" and model.num_stages == 1:
+            # param swapping: layer list streamed through the device
+            # (reference: ZeRO-Infinity offload_param → param swapper).
+            # Multi-stage pipelines keep the PipelineEngine path (streamed
+            # params + the pipe ring is future work; offload_param there is
+            # the reference's compat no-op).
+            from .zero.infinity import ZeroInfinityEngine
+
+            if optimizer is not None:
+                raise ValueError("ZeroInfinityEngine builds its own host "
+                                 "optimizer from the config; a client "
+                                 "optimizer is not supported with "
+                                 "offload_param")
+            engine = ZeroInfinityEngine(model, config=cfg_dict,
+                                        example_batch=example_batch, rng=rng,
+                                        lr_scheduler=lr_scheduler)
+        else:
+            engine = PipelineEngine(model=model, config=config,
+                                    example_batch=example_batch,
+                                    mesh=mesh, rng=rng, optimizer=optimizer,
+                                    lr_scheduler=lr_scheduler,
+                                    dist_init_required=dist_init_required)
     else:
         engine = DeepSpeedEngine(model=model, config=config, loss_fn=loss_fn,
                                  model_parameters=model_parameters,

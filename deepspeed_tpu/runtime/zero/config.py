@@ -40,6 +40,10 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = Field(100_000_000, ge=0)
     max_in_cpu: int = Field(1_000_000_000, ge=0)
     pin_memory: bool = False
+    # TPU-native addition: body layers streamed per block by the
+    # ZeroInfinityEngine (the swap granularity; reference swaps per-param
+    # with buffer_size-sized buffers, here the layer list is the unit)
+    block_layers: int = Field(2, ge=1)
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

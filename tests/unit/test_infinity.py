@@ -1,0 +1,204 @@
+"""ZeRO-Infinity parameter swapping (reference
+``swap_tensor/partitioned_param_swapper.py:259`` + ``zero/stage3.py:465``):
+body-layer params live on host, streamed block-wise through the device."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.layers import cross_entropy_loss
+from deepspeed_tpu.pipe import LayerSpec, PipelineModule
+
+VOCAB = 64
+
+
+class Embed(nn.Module):
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, ids):
+        return nn.Embed(VOCAB, self.hidden)(ids)
+
+
+class Block(nn.Module):
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm()(x)
+        return x + nn.Dense(self.hidden)(nn.tanh(nn.Dense(2 * self.hidden)(h)))
+
+
+class Head(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(VOCAB, use_bias=False)(x)
+
+
+def _module(layers=8, hidden=32):
+    return PipelineModule(
+        [LayerSpec(Embed, hidden=hidden),
+         *[LayerSpec(Block, hidden=hidden) for _ in range(layers)],
+         LayerSpec(Head)],
+        num_stages=1, loss_fn=cross_entropy_loss)
+
+
+def _cfg(block_layers=2, lr=1e-2, device="cpu", **extra):
+    return {"train_batch_size": 8,
+            "zero_optimization": {"offload_param": {
+                "device": device, "block_layers": block_layers, **extra}},
+            "optimizer": {"type": "AdamW", "params": {"lr": lr}},
+            "steps_per_print": 0}
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"inputs": rs.randint(0, VOCAB, (8, 16)),
+            "labels": rs.randint(0, VOCAB, (8, 16))}
+
+
+class TestInfinity:
+    def test_trains_and_converges(self):
+        engine, *_ = ds.initialize(model=_module(), config=_cfg(),
+                                   example_batch=_batch(),
+                                   rng=jax.random.PRNGKey(0))
+        b = _batch()
+        losses = [float(engine.train_batch(b)) for _ in range(8)]
+        assert losses[-1] < losses[0] - 0.5, losses
+
+    def test_gradients_match_dense_execution(self):
+        """Block streaming + per-block vjp must produce the same step as a
+        dense whole-model gradient (same bf16 compute, same host optimizer).
+        """
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+        module = _module(layers=4)
+        b = _batch()
+        engine, *_ = ds.initialize(model=module, config=_cfg(block_layers=2),
+                                   example_batch=b, rng=jax.random.PRNGKey(1))
+
+        # dense reference from the engine's OWN initial host state
+        full_fp32 = {
+            "edges": jax.tree_util.tree_map(
+                lambda a: np.asarray(a, np.float32),
+                jax.device_get(engine.edge_params)),
+            "body": [jax.tree_util.tree_map(
+                lambda a: np.asarray(a, np.float32), lp)
+                for lp in engine.host_body]}
+
+        def dense_loss(p):
+            bf16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else jnp.asarray(a), p)
+            h = module.apply_prefix(bf16["edges"], jnp.asarray(b["inputs"]))
+            for lp in bf16["body"]:
+                h = module._body_module.apply({"params": lp}, h)
+            out = module.apply_suffix(bf16["edges"], h)
+            return module.loss_fn(out, jnp.asarray(b["labels"]))
+
+        g_dense = jax.grad(dense_loss)(full_fp32)
+        ref_opt = HostOffloadOptimizer(full_fp32, "AdamW", {"lr": 1e-2}, None)
+        ref_params, _, _ = ref_opt.step(
+            jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a), np.float32), g_dense))
+
+        engine.train_batch(b)
+
+        ref_body = [jax.tree_util.tree_map(
+            lambda a: np.asarray(a, np.float32), lp)
+            for lp in ref_params["body"]]
+        got_body = [jax.tree_util.tree_map(
+            lambda a: np.asarray(a, np.float32), lp)
+            for lp in engine.host_body]
+        for got, ref in zip(got_body, ref_body):
+            jax.tree_util.tree_map(
+                lambda a, r: np.testing.assert_allclose(a, r, atol=1e-2),
+                got, ref)
+
+    def test_device_working_set_bounded(self):
+        """The capability claim: peak live device bytes during a step stays
+        O(2 blocks), far below the full body — i.e. a model larger than
+        device memory can stream through (reference's '40B on one V100'
+        class, docs/_posts/2021-03-08-zero3-offload.md:75)."""
+        module = _module(layers=16, hidden=256)
+        b = _batch()
+        engine, *_ = ds.initialize(model=module, config=_cfg(block_layers=1),
+                                   example_batch=b, rng=jax.random.PRNGKey(2))
+        body_bytes = engine.body_param_bytes()
+        engine.track_device_memory = True
+        engine.train_batch(b)
+        peak = engine.last_peak_device_bytes
+        # peak includes edges + activations + <=2 streamed blocks + one
+        # block's grads; with 16 single-layer blocks that must stay well
+        # under the full body (which a real big model couldn't fit at all)
+        assert peak < 0.55 * body_bytes + 4_000_000, (peak, body_bytes)
+
+    def test_rejects_bad_configs(self):
+        with pytest.raises(ValueError, match="divide"):
+            ds.initialize(model=_module(layers=7), config=_cfg(block_layers=2),
+                          example_batch=_batch())
+        with pytest.raises(ValueError, match="gas=1"):
+            ds.initialize(model=_module(),
+                          config={**_cfg(), "train_batch_size": 8,
+                                  "gradient_accumulation_steps": 2},
+                          example_batch=_batch())
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        engine, *_ = ds.initialize(model=_module(layers=4),
+                                   config=_cfg(block_layers=2),
+                                   example_batch=_batch(),
+                                   rng=jax.random.PRNGKey(5))
+        b = _batch()
+        for _ in range(3):
+            engine.train_batch(b)
+        l_before = float(engine.train_batch(b))
+        engine.save_checkpoint(str(tmp_path))
+
+        fresh, *_ = ds.initialize(model=_module(layers=4),
+                                  config=_cfg(block_layers=2),
+                                  example_batch=_batch(),
+                                  rng=jax.random.PRNGKey(99))
+        fresh.load_checkpoint(str(tmp_path))
+        assert fresh.global_steps == engine.global_steps
+        for got, ref in zip(fresh.host_body, engine.host_body):
+            jax.tree_util.tree_map(
+                lambda a, r: np.testing.assert_array_equal(
+                    np.asarray(a, np.float32), np.asarray(r, np.float32)),
+                got, ref)
+        # training continues identically from the restored state
+        la = float(engine.train_batch(_batch(seed=3)))
+        lb = float(fresh.train_batch(_batch(seed=3)))
+        assert abs(la - lb) < 1e-3
+
+    def test_lr_scheduler_applies(self):
+        cfg = _cfg(block_layers=2)
+        cfg["scheduler"] = {"type": "WarmupLR",
+                            "params": {"warmup_min_lr": 0.0,
+                                       "warmup_max_lr": 1e-2,
+                                       "warmup_num_steps": 10}}
+        engine, *_ = ds.initialize(model=_module(layers=4), config=cfg,
+                                   example_batch=_batch(),
+                                   rng=jax.random.PRNGKey(6))
+        assert engine.lr_scheduler is not None
+        lr0 = engine._host_opt.current_lr()
+        engine.train_batch(_batch())
+        engine.train_batch(_batch())
+        assert engine._host_opt.current_lr() > lr0  # warming up
+
+    def test_nvme_moments_compose(self, tmp_path):
+        """offload_param (streamed weights) + offload_optimizer nvme
+        (spilled moments): the full ZeRO-Infinity working set."""
+        cfg = _cfg(block_layers=2)
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(tmp_path)}
+        engine, *_ = ds.initialize(model=_module(layers=4), config=cfg,
+                                   example_batch=_batch(),
+                                   rng=jax.random.PRNGKey(3))
+        b = _batch()
+        losses = [float(engine.train_batch(b)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+        assert any(p.name.startswith("moment") for p in tmp_path.iterdir())
