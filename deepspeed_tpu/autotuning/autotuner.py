@@ -143,6 +143,63 @@ class Autotuner:
             return tput * self.model_info()["num_params"]
         return tput
 
+    def _run_experiment(self, exp: Experiment, steps: int) -> None:
+        config = _merged(self.base_config, exp.overrides)
+        try:
+            exp.metric_value = self._measure(config, steps)
+        except Exception as e:  # candidate failed (OOM, invalid combo...)
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.debug(traceback.format_exc())
+        with open(os.path.join(self.cfg.results_dir, f"{exp.name}.json"),
+                  "w") as f:
+            json.dump(dataclasses.asdict(exp), f, indent=2)
+        log_dist(f"autotune {exp.name}: "
+                 f"{exp.metric_value if exp.ok else exp.error}", ranks=[0])
+
+    def _experiment_order(self) -> "list":
+        """Evaluation order. ``tuner_type="gridsearch"`` keeps space order;
+        ``"model"`` runs the reference's model-based exploration
+        (``tuner/model_based_tuner.py``): seed with 2 measurements, then
+        repeatedly fit the cost model on everything evaluated so far and
+        pick the highest-predicted unevaluated candidate (with every 5th
+        pick exploratory, the reference's random_exploration_ratio=0.2 made
+        deterministic), so dominated corners of the space are skipped when
+        early stopping kicks in."""
+        exps = self.experiments
+        if self.cfg.tuner_type != "model" or len(exps) <= 2:
+            yield from exps
+            return
+        from .cost_model import RidgeCostModel, config_features, flatten_config
+
+        feats = [config_features(flatten_config(
+            _merged(self.base_config, e.overrides))) for e in exps]
+        done: List[int] = []
+        # seed: first and last of the space (cheapest + most aggressive)
+        pending = [0, len(exps) - 1]
+        picks = 0
+        while True:
+            while pending:
+                i = pending.pop(0)
+                if i not in done:
+                    done.append(i)
+                    yield exps[i]
+            remaining = [i for i in range(len(exps)) if i not in done]
+            evaluated_ok = [i for i in done if exps[i].ok]
+            if not remaining:
+                return
+            if len(evaluated_ok) < 2:
+                pending.append(remaining[0])
+                continue
+            picks += 1
+            if picks % 5 == 0:  # deterministic exploration slot
+                pending.append(remaining[len(remaining) // 2])
+                continue
+            model = RidgeCostModel().fit(
+                [feats[i] for i in evaluated_ok],
+                [exps[i].metric_value for i in evaluated_ok])
+            pred = model.predict([feats[i] for i in remaining])
+            pending.append(remaining[int(np.argmax(pred))])
+
     def tune(self, steps: Optional[int] = None) -> Dict:
         """Run the space; returns the best full config. Writes per-experiment
         results + best_config.json under ``results_dir``."""
@@ -152,18 +209,8 @@ class Autotuner:
         best: Optional[Experiment] = None
         stale = 0
         self.experiments = self.generate_experiments()
-        for exp in self.experiments:
-            config = _merged(self.base_config, exp.overrides)
-            try:
-                exp.metric_value = self._measure(config, steps)
-            except Exception as e:  # candidate failed (OOM, invalid combo...)
-                exp.error = f"{type(e).__name__}: {e}"
-                logger.debug(traceback.format_exc())
-            with open(os.path.join(self.cfg.results_dir, f"{exp.name}.json"),
-                      "w") as f:
-                json.dump(dataclasses.asdict(exp), f, indent=2)
-            log_dist(f"autotune {exp.name}: "
-                     f"{exp.metric_value if exp.ok else exp.error}", ranks=[0])
+        for exp in self._experiment_order():
+            self._run_experiment(exp, steps)
             if exp.ok and (best is None or exp.metric_value > best.metric_value):
                 best, stale = exp, 0
             else:
@@ -174,7 +221,7 @@ class Autotuner:
         if best is None:
             raise RuntimeError(
                 f"autotuning: every candidate failed "
-                f"({[e.error for e in self.experiments]})")
+                f"({[e.error for e in self.experiments if e.error]})")
         best_config = _merged(self.base_config, best.overrides)
         with open(os.path.join(self.cfg.results_dir, "best_config.json"), "w") as f:
             json.dump({"name": best.name, "metric": self.cfg.metric,

@@ -12,7 +12,16 @@ training is compression-aware while fp32 masters stay exact. The schedule is
 traced arithmetic on the step counter (one executable covers the ramp).
 
 Supported method groups (reference ``config.py`` schema):
-- ``weight_quantization``  — grouped fake-quant at target bits
+- ``weight_quantization``  — grouped fake-quant at target bits; embedding
+  tables (paths ending ``/embedding``) default to TOKEN-WISE groups — one
+  scale per row — the reference's ``Embedding_Compress`` rule
+  (``basic_layer.py:61``: "for embedding, we always use token-wise
+  quantization")
+- ``activation_quantization`` — fake-quant on matched modules' INPUT
+  activations (reference ``basic_layer.py`` activation path +
+  ``utils.py:56-184`` quantizers), realized as a flax ``intercept_methods``
+  hook inside the compiled step: dynamic per-batch range, symmetric or
+  asymmetric, straight-through gradients
 - ``sparse_pruning``       — unstructured magnitude pruning to a ratio
 - ``row_pruning``          — structured: lowest-L2 output rows zeroed
 - ``head_pruning``         — structured over attention heads (requires
@@ -90,6 +99,7 @@ class CompressionScheduler:
         self.methods: List[_Method] = []
         cfgs = {
             "weight_quantization": "quantize",
+            "activation_quantization": "activation",
             "sparse_pruning": "sparse",
             "row_pruning": "row",
             "head_pruning": "head",
@@ -135,7 +145,7 @@ class CompressionScheduler:
                 return p
             out = p
             for m in self.methods:
-                if not self._matches(m, path):
+                if m.kind == "activation" or not self._matches(m, path):
                     continue
                 if m.kind == "quantize":
                     from ..runtime.quantize import quantize_dequantize
@@ -143,7 +153,12 @@ class CompressionScheduler:
                     bits = jnp.asarray(
                         float(m.params.get("target_bits",
                                            m.params.get("quantize_bits", 8))))
-                    groups = int(m.params.get("quantization_groups", 1))
+                    if "quantization_groups" in m.params:
+                        groups = int(m.params["quantization_groups"])
+                    elif path.endswith("embedding"):
+                        groups = out.shape[0]  # token-wise (reference rule)
+                    else:
+                        groups = 1
                     if out.size % max(groups, 1):
                         groups = 1
                     q = quantize_dequantize(
@@ -173,6 +188,48 @@ class CompressionScheduler:
 
         return jax.tree_util.tree_unflatten(
             treedef, [one(kp, p) for kp, p in flat])
+
+    # -- activation quantization (flax interceptor) ---------------------
+
+    @property
+    def has_activation_methods(self) -> bool:
+        return any(m.kind == "activation" for m in self.methods)
+
+    def activation_interceptor(self, step):
+        """A ``flax.linen.intercept_methods`` hook fake-quantizing the input
+        activations of modules whose PATH matches an activation_quantization
+        group (reference: the compressed modules quantize their forward
+        inputs). Straight-through gradients; dynamic per-batch range."""
+        methods = [m for m in self.methods if m.kind == "activation"]
+
+        def fake_quant(x, m):
+            if not hasattr(x, "dtype") or \
+                    not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            from ..runtime.quantize import quantize_dequantize
+
+            bits = jnp.asarray(float(m.params.get(
+                "bits", m.params.get("target_bits", 8))))
+            sym = m.params.get("quantization_type",
+                               "symmetric") == "symmetric"
+            # same grid as the weight path — one quantizer implementation
+            # (runtime/quantize.py), per-tensor dynamic range
+            q = quantize_dequantize(x.astype(jnp.float32), bits, 1,
+                                    symmetric=sym)
+            gate = _ratio_at(step, m.offset, m.end, 1.0)
+            q = jnp.where(gate > 0, q, x.astype(jnp.float32)).astype(x.dtype)
+            return x + jax.lax.stop_gradient(q - x)
+
+        def interceptor(next_fun, args, kwargs, context):
+            path = "/".join(context.module.path) if context.module.path \
+                else (context.module.name or "")
+            for m in methods:
+                if self._matches(m, path):
+                    args = tuple(fake_quant(a, m) for a in args)
+                    break
+            return next_fun(*args, **kwargs)
+
+        return interceptor
 
 
 def init_compression(params: Any, compression_config: Dict,

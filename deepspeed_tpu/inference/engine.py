@@ -204,11 +204,26 @@ class InferenceEngine:
         attention_mask = jnp.asarray(attention_mask, jnp.int32)
 
         key = (B, T, max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        was_cached = key in self._generate_cache
         fn = self._generate_cache.get(key)
         if fn is None:
             fn = self._build_generate(B, T, max_new_tokens, do_sample, temperature,
                                       top_k, top_p, eos_token_id)
             self._generate_cache[key] = fn
+        if getattr(self, "_profile_model_time", False):
+            import time as _time
+
+            if not was_cached:
+                # exclude XLA compile from the profile: warm the program
+                # first (deterministic: same seed → same tokens), then time
+                np.asarray(fn(self.params, input_ids, attention_mask,
+                              jax.random.PRNGKey(seed)))
+            t0 = _time.perf_counter()
+            out = fn(self.params, input_ids, attention_mask,
+                     jax.random.PRNGKey(seed))
+            np.asarray(out)  # device fence: measure real latency
+            self._model_times.append(_time.perf_counter() - t0)
+            return out
         return fn(self.params, input_ids, attention_mask, jax.random.PRNGKey(seed))
 
     # -- parity helpers --------------------------------------------------
@@ -216,8 +231,19 @@ class InferenceEngine:
     def module_state_dict(self):
         return self.params
 
-    def profile_model_time(self, *a, **k):  # reference :90 region
-        pass
+    def profile_model_time(self, use_cuda_events: bool = True) -> None:
+        """Start collecting per-generate wall latencies (reference
+        ``inference/engine.py:90`` region; ``use_cuda_events`` accepted for
+        API parity — the fence here is a host-side value barrier)."""
+        self._profile_model_time = True
+        self._model_times = []
+
+    def model_times(self):
+        """Collected latencies since ``profile_model_time`` (reference
+        ``model_times()``: returns and resets)."""
+        times = list(getattr(self, "_model_times", []))
+        self._model_times = []
+        return times
 
 
 def init_inference(model=None, config=None, mp_size: Optional[int] = None, dtype=None,

@@ -231,10 +231,19 @@ def _stack_layers(params: Dict, n_layers: int, leaf_fn, scan_layers: bool,
                 _set(params, f"{base}_{i}/{path}", w)
 
 
-def _split_fused_qkv(w, b, n_heads: int, head_dim: int):
-    """BLOOM/NeoX fused QKV: HF weight ``[3*H*D, in]`` laid out ``[H, 3, D]``
-    along the output dim → three ``[in, H*D]`` flax kernels (+ biases)."""
+def _split_fused_qkv(w, b, n_heads: int, head_dim: int, interleaved=True):
+    """Fused QKV → three ``[in, H*D]`` flax kernels (+ biases).
+
+    ``interleaved=True``: the BLOOM/NeoX HF layout ``[H, 3, D]`` along the
+    output dim. ``interleaved=False``: plain ``[Q; K; V]`` contiguous rows —
+    the Megatron layout after the reshape loader's QKV-aware merge
+    (``checkpoint/reshape.py merge_qkv`` re-interleaves every on-disk
+    variant to this)."""
     hidden_out = n_heads * head_dim
+    if not interleaved:
+        kernels = [part.T for part in np.split(w, 3, axis=0)]
+        biases = None if b is None else list(np.split(b, 3, axis=0))
+        return kernels, biases
     w = w.reshape(n_heads, 3, head_dim, -1)
     kernels = [w[:, j].reshape(hidden_out, -1).T for j in range(3)]
     biases = None
@@ -648,6 +657,137 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
         leaves["ln_mlp/scale"] = sd[f"{p}ln_2.weight"]
         leaves["ln_mlp/bias"] = sd[f"{p}ln_2.bias"]
         return leaves
+
+
+class MegatronLayerPolicy(_GenericTransformerPolicy):
+    """Megatron-LM GPT → generic decoder (reference ``replace_policy.py:281``
+    ``MegatronLayerPolicy`` targets ``ParallelTransformerLayer``; here the
+    ingestion unit is the Megatron STATE DICT — merge TP-sharded
+    ``mp_rank_XX`` files first via ``checkpoint.reshape.
+    ShardedCheckpointLoader`` (which re-interleaves the fused-QKV row
+    layouts to [Q;K;V]), then map onto the generic graph).
+
+    Megatron GPT semantics: learned absolute positions, gelu, pre-LN with a
+    final layernorm, tied word-embedding head, fused ``query_key_value``.
+    Handles the classic ``language_model.transformer.layers.N`` and newer
+    ``language_model.encoder.layers.N`` naming.
+
+    Fused-QKV layout depends on the checkpoint version (reference
+    ``state_dict_factory.py:243``): the reshape loader's merge leaves
+    version 1.0/2.0 rows HEAD-INTERLEAVED ``[H, 3, D]`` (rank-major concat
+    preserves each head's [3, D] block) and re-groups version 0 to
+    contiguous ``[Q; K; V]`` — ``qkv_version`` must match the files.
+    """
+
+    hf_model_types = ()  # not an HF auto-match; explicit ingestion only
+    qkv_version: float = 2.0
+
+    @staticmethod
+    def _prefix(sd) -> str:
+        for p in ("language_model.transformer.", "language_model.encoder.",
+                  "transformer.", "encoder."):
+            if any(k.startswith(p + "layers.0.") for k in sd):
+                return p
+        raise KeyError("no Megatron transformer layers found in state dict "
+                       "(expected language_model.{transformer|encoder}."
+                       "layers.N.*)")
+
+    @staticmethod
+    def _embedding_prefix(sd) -> str:
+        for p in ("language_model.embedding.", "embedding."):
+            if any(k.startswith(p) for k in sd):
+                return p
+        raise KeyError("no Megatron embedding block in state dict")
+
+    @classmethod
+    def infer_config(cls, sd, num_attention_heads: int, scan_layers=True,
+                     norm_eps: float = 1e-5):
+        """Megatron checkpoints carry no HF config; everything except the
+        head count is recoverable from the weight shapes."""
+        from ..models.transformer import TransformerConfig
+
+        tp = cls._prefix(sd)
+        ep = cls._embedding_prefix(sd)
+        vocab, hidden = sd[f"{ep}word_embeddings.weight"].shape
+        max_pos = sd[f"{ep}position_embeddings.weight"].shape[0]
+        n_layers = 1 + max(
+            int(k.split("layers.")[1].split(".")[0])
+            for k in sd if k.startswith(f"{tp}layers."))
+        inter = sd[f"{tp}layers.0.mlp.dense_h_to_4h.weight"].shape[0]
+        return TransformerConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            num_hidden_layers=n_layers,
+            num_attention_heads=num_attention_heads,
+            max_position_embeddings=max_pos, pos_embedding="learned",
+            activation="gelu", norm_eps=norm_eps, pre_layernorm=True,
+            final_layernorm=True, tie_word_embeddings=True,
+            scan_layers=scan_layers)
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        # hc is (sd, num_attention_heads) packed by convert_state_dict
+        sd, heads = hc
+        return cls.infer_config(sd, heads, scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        ep = cls._embedding_prefix(sd)
+        tp = cls._prefix(sd)
+        _set(params, "model/embed_tokens/embedding",
+             sd[f"{ep}word_embeddings.weight"][:cfg.vocab_size])
+        _set(params, "model/embed_positions/embedding",
+             sd[f"{ep}position_embeddings.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{tp}final_layernorm.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{tp}final_layernorm.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        p = f"{cls._prefix(sd)}layers.{i}."
+        leaves = {}
+        (qw, kw, vw), (qb, kb, vb) = _split_fused_qkv(
+            sd[f"{p}attention.query_key_value.weight"],
+            sd[f"{p}attention.query_key_value.bias"],
+            cfg.num_attention_heads, cfg.head_dim,
+            interleaved=(cls.qkv_version != 0))
+        leaves["attn/q_proj/kernel"], leaves["attn/q_proj/bias"] = qw, qb
+        leaves["attn/k_proj/kernel"], leaves["attn/k_proj/bias"] = kw, kb
+        leaves["attn/v_proj/kernel"], leaves["attn/v_proj/bias"] = vw, vb
+        leaves["attn/o_proj/kernel"] = sd[f"{p}attention.dense.weight"].T
+        leaves["attn/o_proj/bias"] = sd[f"{p}attention.dense.bias"]
+        leaves["mlp/fc_in/kernel"] = sd[f"{p}mlp.dense_h_to_4h.weight"].T
+        leaves["mlp/fc_in/bias"] = sd[f"{p}mlp.dense_h_to_4h.bias"]
+        leaves["mlp/fc_out/kernel"] = sd[f"{p}mlp.dense_4h_to_h.weight"].T
+        leaves["mlp/fc_out/bias"] = sd[f"{p}mlp.dense_4h_to_h.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}input_layernorm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}input_layernorm.bias"]
+        leaves["ln_mlp/scale"] = sd[f"{p}post_attention_layernorm.weight"]
+        leaves["ln_mlp/bias"] = sd[f"{p}post_attention_layernorm.bias"]
+        return leaves
+
+    @classmethod
+    def convert_state_dict(cls, hf_config, sd, scan_layers: bool = True,
+                           qkv_version: float = 2.0):
+        # hf_config here is the head count (int) — Megatron sds carry no
+        # config object
+        policy = type(f"_Megatron_v{qkv_version}", (cls,),
+                      {"qkv_version": float(qkv_version)})
+        return super(MegatronLayerPolicy, policy).convert_state_dict(
+            (sd, int(hf_config)), sd, scan_layers)
+
+    @classmethod
+    def from_megatron_checkpoint(cls, ckpt_files, num_attention_heads: int,
+                                 version: float = 2.0,
+                                 scan_layers: bool = True):
+        """(model, params) from Megatron ``mp_rank_XX`` files at any TP
+        degree (merged through the reshape loader's QKV-aware merge; the
+        merged layout per ``version`` drives the Q/K/V unfusing)."""
+        from ..checkpoint.reshape import ShardedCheckpointLoader
+
+        loader = ShardedCheckpointLoader(list(ckpt_files), version=version)
+        sd = loader.load(mp_world_size=1, mp_rank=0)
+        return cls.convert_state_dict(num_attention_heads, sd,
+                                      scan_layers=scan_layers,
+                                      qkv_version=version)
 
 
 #: All registered policies (reference: ``replace_policies`` list)

@@ -270,6 +270,23 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_learning)
+            # every distinct truncated seqlen is a distinct compiled program
+            # (XLA static shapes); warn when a config implies a compile storm
+            cs = self.curriculum_scheduler
+            if getattr(cs, "difficulties", None) is not None:
+                n_shapes = len(set(cs.difficulties))  # fixed_discrete
+                knob = "the difficulty list"
+            else:
+                step = max(1, getattr(cs, "difficulty_step", 1))
+                n_shapes = (cs.max_difficulty - cs.min_difficulty) // step + 1
+                knob = "difficulty_step"
+            if n_shapes > 32:
+                logger.warning(
+                    f"curriculum_learning implies ~{n_shapes} distinct "
+                    f"sequence lengths = {n_shapes} XLA compilations "
+                    f"(min={cs.min_difficulty}, max={cs.max_difficulty}). "
+                    f"Coarsen {knob} to bound compile time (each distinct "
+                    f"length is one program).")
         self._compression = None
         if self._config.compression_config:
             from ..compression.compress import init_compression
@@ -511,13 +528,25 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 # step's intensity (reference engine.py:1620 steps the
                 # compression_scheduler during training)
                 params = compression.apply(params, moq_step)
-            if loss_fn is not None:
-                loss, aux = loss_fn(params, batch, rng)
-            elif pld_theta is not None:
-                loss, aux = self._default_loss(params, batch, rng,
-                                               pld_theta=pld_theta)
-            else:
-                loss, aux = self._default_loss(params, batch, rng)
+            import contextlib
+
+            ictx = contextlib.nullcontext()
+            if compression is not None and moq_step is not None and \
+                    compression.has_activation_methods:
+                # activation fake-quant on matched modules' inputs
+                # (reference basic_layer.py activation path)
+                import flax.linen as fnn
+
+                ictx = fnn.intercept_methods(
+                    compression.activation_interceptor(moq_step))
+            with ictx:
+                if loss_fn is not None:
+                    loss, aux = loss_fn(params, batch, rng)
+                elif pld_theta is not None:
+                    loss, aux = self._default_loss(params, batch, rng,
+                                                   pld_theta=pld_theta)
+                else:
+                    loss, aux = self._default_loss(params, batch, rng)
             return (loss.astype(jnp.float32) * scale, loss)
 
         grad_fn = jax.grad(compute_loss, has_aux=True)

@@ -30,10 +30,17 @@ def quantize_dequantize(x: jnp.ndarray, bits: jnp.ndarray, groups: int,
     dim's ``groups`` equal slices (reference grouped quantizer,
     ``csrc/quantization/quantizer.cu``)."""
     orig_shape, orig_dtype = x.shape, x.dtype
-    x32 = x.astype(jnp.float32).reshape(groups, -1)
+    # groups == 1: per-tensor range over the original shape — same grid,
+    # no flatten round-trip (the reshape also tripped an XLA:CPU collective
+    # -rendezvous deadlock when this runs inside the compiled train step
+    # with the persistent compile cache enabled; see test_compression)
+    x32 = x.astype(jnp.float32)
+    if groups != 1:
+        x32 = x32.reshape(groups, -1)
+    axes = -1 if groups != 1 else None  # per-group vs per-tensor range
     levels = 2.0 ** (bits.astype(jnp.float32) - 1.0) - 1.0
     if symmetric:
-        scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / jnp.maximum(levels, 1.0)
+        scale = jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / jnp.maximum(levels, 1.0)
         scale = jnp.where(scale == 0, 1.0, scale)
         q = x32 / scale
         q = q + jax.random.uniform(rng, q.shape, minval=-0.5, maxval=0.5) \
@@ -41,8 +48,8 @@ def quantize_dequantize(x: jnp.ndarray, bits: jnp.ndarray, groups: int,
         q = jnp.clip(jnp.round(q), -levels, levels)
         out = q * scale
     else:
-        lo = jnp.min(x32, axis=-1, keepdims=True)
-        hi = jnp.max(x32, axis=-1, keepdims=True)
+        lo = jnp.min(x32, axis=axes, keepdims=True)
+        hi = jnp.max(x32, axis=axes, keepdims=True)
         span = jnp.maximum(hi - lo, 1e-8)
         n = 2.0 ** bits.astype(jnp.float32) - 1.0
         scale = span / n

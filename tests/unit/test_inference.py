@@ -370,3 +370,22 @@ def test_init_inference_checkpoint_dir(tmp_path):
                           pad_token_id=0).numpy()[:, 6:]
     ours = np.asarray(engine.generate(ids, max_new_tokens=4, do_sample=False))
     np.testing.assert_array_equal(ours, ref)
+
+
+def test_profile_model_time_collects_latencies():
+    """reference engine.py:90 profile_model_time / model_times parity."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (1, 8))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    engine = ds.init_inference(model, params=params, max_out_tokens=16)
+    engine.profile_model_time()
+    engine.generate(ids, max_new_tokens=4)
+    engine.generate(ids, max_new_tokens=4)
+    times = engine.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+    assert engine.model_times() == []  # reset after read
