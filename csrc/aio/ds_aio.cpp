@@ -4,13 +4,17 @@
 // with a submit/complete thread pool backing ZeRO-Infinity).
 //
 // This image has no libaio/liburing headers, so the handle runs a worker
-// thread pool over pwrite/pread with large block splitting — on TPU-VM local
-// SSD the page cache + parallel threads saturate the device comfortably; the
-// C ABI mirrors the reference handle surface (block_size, queue_depth,
-// single_submit, overlap_events, num_threads) so an io_uring backend can slot
-// in behind the same API.
+// thread pool over pwrite/pread with large block splitting; with
+// use_o_direct (ds_aio_handle_create2) aligned chunks bypass the page cache
+// via O_DIRECT through per-thread 4 KiB-aligned bounce buffers — the
+// reference's pinned-buffer pattern (deepspeed_aio_common) — and unaligned
+// tails fall back to a buffered fd on the same file. The C ABI mirrors the
+// reference handle surface (block_size, queue_depth, single_submit,
+// overlap_events, num_threads) so an io_uring backend can slot in behind
+// the same API.
 
 #include <fcntl.h>
+#include <stdlib.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -26,17 +30,21 @@
 
 namespace {
 
-// One submit() call = one Group. The group owns the file descriptor and its
-// own error count; the worker finishing the group's last sub-op closes the fd
+constexpr int64_t kDirectAlign = 4096;
+
+// One submit() call = one Group. The group owns the file descriptors and its
+// own error count; the worker finishing the group's last sub-op closes them
 // (mirrors the reference's close(completed_op->_fd) on completion), so long
 // async runs cannot exhaust the process fd limit, and one group's failure
 // does not bleed into other submits' return codes.
 struct Group {
-  int fd;
+  int fd;          // buffered fd (always valid)
+  int fd_direct;   // O_DIRECT fd, or -1 (filesystem refused / direct off)
   bool async_owned;  // worker deletes the group after the last sub-op
   int64_t remaining;  // guarded by Handle::mu
   std::atomic<int64_t> errors{0};
-  Group(int fd_, bool async_, int64_t n) : fd(fd_), async_owned(async_), remaining(n) {}
+  Group(int fd_, int fdd_, bool async_, int64_t n)
+      : fd(fd_), fd_direct(fdd_), async_owned(async_), remaining(n) {}
 };
 
 struct Op {
@@ -50,6 +58,7 @@ struct Op {
 struct Handle {
   int64_t block_size;
   int num_threads;
+  bool o_direct = false;
   std::vector<std::thread> workers;
   std::deque<Op> queue;
   std::mutex mu;
@@ -61,12 +70,19 @@ struct Handle {
   bool shutdown = false;
 
   void worker() {
+    // per-thread aligned bounce buffer for the O_DIRECT path (the
+    // reference's pinned buffer); lazily sized to block_size
+    char* bounce = nullptr;
+    int64_t bounce_size = 0;
     for (;;) {
       Op op;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv.wait(lk, [&] { return shutdown || !queue.empty(); });
-        if (shutdown && queue.empty()) return;
+        if (shutdown && queue.empty()) {
+          free(bounce);
+          return;
+        }
         op = queue.front();
         queue.pop_front();
       }
@@ -74,9 +90,35 @@ struct Handle {
       while (done < op.nbytes) {
         int64_t chunk = op.nbytes - done;
         if (block_size > 0 && chunk > block_size) chunk = block_size;
-        ssize_t r = op.write
-                        ? pwrite(op.group->fd, op.buf + done, chunk, op.offset + done)
-                        : pread(op.group->fd, op.buf + done, chunk, op.offset + done);
+        int64_t pos = op.offset + done;
+        bool direct = op.group->fd_direct >= 0 &&
+                      pos % kDirectAlign == 0 && chunk % kDirectAlign == 0;
+        ssize_t r;
+        if (direct) {
+          if (bounce_size < chunk) {
+            free(bounce);
+            bounce = nullptr;
+            if (posix_memalign(reinterpret_cast<void**>(&bounce),
+                               kDirectAlign, chunk) != 0) {
+              bounce_size = 0;
+              direct = false;
+            } else {
+              bounce_size = chunk;
+            }
+          }
+        }
+        if (direct) {
+          if (op.write) {
+            memcpy(bounce, op.buf + done, chunk);
+            r = pwrite(op.group->fd_direct, bounce, chunk, pos);
+          } else {
+            r = pread(op.group->fd_direct, bounce, chunk, pos);
+            if (r > 0) memcpy(op.buf + done, bounce, r);
+          }
+        } else {
+          r = op.write ? pwrite(op.group->fd, op.buf + done, chunk, pos)
+                       : pread(op.group->fd, op.buf + done, chunk, pos);
+        }
         if (r <= 0) {
           op.group->errors.fetch_add(1);
           break;
@@ -93,6 +135,7 @@ struct Handle {
         ++completed;
         if (--op.group->remaining == 0) {
           close(op.group->fd);
+          if (op.group->fd_direct >= 0) close(op.group->fd_direct);
           if (op.group->async_owned) {
             if (op.group->errors.load()) ++async_group_errors;
             delete op.group;
@@ -109,6 +152,11 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
   int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
   int fd = open(path, flags, 0644);
   if (fd < 0) return -1;
+  int fd_direct = -1;
+  if (h->o_direct && h->block_size % kDirectAlign == 0) {
+    // refused O_DIRECT (e.g. tmpfs) silently degrades to buffered IO
+    fd_direct = open(path, flags | O_DIRECT, 0644);
+  }
   // split into per-thread sub-ops so one big tensor uses the whole pool
   int64_t nsub = h->num_threads > 0 ? h->num_threads : 1;
   int64_t sub = (nbytes + nsub - 1) / nsub;
@@ -120,11 +168,13 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
     ops.push_back(Op{write, static_cast<char*>(buf) + off, len, offset + off,
                      nullptr});
   }
-  if (ops.empty()) {  // zero-byte op: no worker will ever close the fd
+  if (ops.empty()) {  // zero-byte op: no worker will ever close the fds
     close(fd);
+    if (fd_direct >= 0) close(fd_direct);
     return 0;
   }
-  auto* group = new Group(fd, async_op != 0, static_cast<int64_t>(ops.size()));
+  auto* group = new Group(fd, fd_direct, async_op != 0,
+                          static_cast<int64_t>(ops.size()));
   for (auto& op : ops) op.group = group;
   {
     std::lock_guard<std::mutex> lk(h->mu);
@@ -149,18 +199,26 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
 
 extern "C" {
 
-void* ds_aio_handle_create(int64_t block_size, int queue_depth,
-                           int single_submit, int overlap_events,
-                           int num_threads) {
+void* ds_aio_handle_create2(int64_t block_size, int queue_depth,
+                            int single_submit, int overlap_events,
+                            int num_threads, int use_o_direct) {
   (void)queue_depth;
   (void)single_submit;
   (void)overlap_events;
   auto* h = new Handle();
   h->block_size = block_size > 0 ? block_size : (1 << 20);
   h->num_threads = num_threads > 0 ? num_threads : 1;
+  h->o_direct = use_o_direct != 0;
   for (int i = 0; i < h->num_threads; ++i)
     h->workers.emplace_back([h] { h->worker(); });
   return h;
+}
+
+void* ds_aio_handle_create(int64_t block_size, int queue_depth,
+                           int single_submit, int overlap_events,
+                           int num_threads) {
+  return ds_aio_handle_create2(block_size, queue_depth, single_submit,
+                               overlap_events, num_threads, 0);
 }
 
 void ds_aio_handle_destroy(void* handle) {

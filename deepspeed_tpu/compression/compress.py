@@ -223,8 +223,14 @@ class CompressionScheduler:
         def interceptor(next_fun, args, kwargs, context):
             path = "/".join(context.module.path) if context.module.path \
                 else (context.module.name or "")
+            parent = "/".join(path.split("/")[:-1])
             for m in methods:
-                if self._matches(m, path):
+                # quantize at the module where the match BEGINS, not at
+                # every descendant boundary (pattern "mlp" targets the mlp
+                # block's input once — not fc_in's and fc_out's inputs too;
+                # the reference quantizes each matched layer's own input)
+                if self._matches(m, path) and \
+                        not (parent and self._matches(m, parent)):
                     args = tuple(fake_quant(a, m) for a in args)
                     break
             return next_fun(*args, **kwargs)

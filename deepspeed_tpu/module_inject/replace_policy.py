@@ -234,11 +234,12 @@ def _stack_layers(params: Dict, n_layers: int, leaf_fn, scan_layers: bool,
 def _split_fused_qkv(w, b, n_heads: int, head_dim: int, interleaved=True):
     """Fused QKV → three ``[in, H*D]`` flax kernels (+ biases).
 
-    ``interleaved=True``: the BLOOM/NeoX HF layout ``[H, 3, D]`` along the
-    output dim. ``interleaved=False``: plain ``[Q; K; V]`` contiguous rows —
-    the Megatron layout after the reshape loader's QKV-aware merge
-    (``checkpoint/reshape.py merge_qkv`` re-interleaves every on-disk
-    variant to this)."""
+    ``interleaved=True``: the head-interleaved ``[H, 3, D]`` layout along
+    the output dim — BLOOM/NeoX HF fused weights, and Megatron v1.0/v2.0
+    checkpoints after the reshape loader's merge (rank-major concat keeps
+    each head's [3, D] block). ``interleaved=False``: plain ``[Q; K; V]``
+    contiguous rows — Megatron VERSION 0 only, which ``merge_qkv``
+    re-groups to this form."""
     hidden_out = n_heads * head_dim
     if not interleaved:
         kernels = [part.T for part in np.split(w, 3, axis=0)]

@@ -16,20 +16,24 @@ import numpy as np
 class AsyncIOHandle:
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
                  single_submit: bool = False, overlap_events: bool = False,
-                 num_threads: int = 1):
+                 num_threads: int = 1, use_o_direct: bool = False):
         from op_builder import AsyncIOBuilder
 
         self._lib = AsyncIOBuilder().load()
-        self._lib.ds_aio_handle_create.restype = ctypes.c_void_p
+        self._lib.ds_aio_handle_create2.restype = ctypes.c_void_p
         self._lib.ds_aio_pread.restype = ctypes.c_int64
         self._lib.ds_aio_pwrite.restype = ctypes.c_int64
         self._lib.ds_aio_wait.restype = ctypes.c_int64
-        self._h = self._lib.ds_aio_handle_create(
+        # O_DIRECT (reference: libaio O_DIRECT is the default path): aligned
+        # chunks bypass the page cache through per-thread aligned bounce
+        # buffers; filesystems that refuse O_DIRECT degrade to buffered IO
+        self._h = self._lib.ds_aio_handle_create2(
             ctypes.c_int64(block_size), ctypes.c_int(queue_depth),
             ctypes.c_int(int(single_submit)), ctypes.c_int(int(overlap_events)),
-            ctypes.c_int(num_threads))
+            ctypes.c_int(num_threads), ctypes.c_int(int(use_o_direct)))
         self.block_size = block_size
         self.num_threads = num_threads
+        self.use_o_direct = use_o_direct
 
     def _buf(self, array: np.ndarray):
         assert array.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
@@ -85,7 +89,7 @@ class AsyncIOHandle:
 
 def aio_handle(block_size: int = 1 << 20, queue_depth: int = 32,
                single_submit: bool = False, overlap_events: bool = False,
-               num_threads: int = 1) -> AsyncIOHandle:
+               num_threads: int = 1, use_o_direct: bool = False) -> AsyncIOHandle:
     """Reference factory name (``deepspeed.ops.aio.aio_handle``)."""
     return AsyncIOHandle(block_size, queue_depth, single_submit, overlap_events,
-                         num_threads)
+                         num_threads, use_o_direct)

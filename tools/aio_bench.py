@@ -1,0 +1,85 @@
+"""Async-IO throughput sweep (reference ``csrc/aio/py_test/
+aio_bench_perf_sweep.py``): write+read GB/s over (block_size, threads,
+o_direct) on a target directory. One JSON line per point + a summary line.
+
+Run: ``python tools/aio_bench.py [--dir /path/on/nvme] [--mb 256]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_point(path, mb, block_size, threads, direct):
+    from deepspeed_tpu.ops.aio import aio_handle
+
+    h = aio_handle(block_size=block_size, num_threads=threads,
+                   use_o_direct=direct)
+    data = np.random.RandomState(0).bytes(mb << 20)
+    buf = np.frombuffer(data, np.uint8).copy()
+    # buffered mode must pay for durability INSIDE the timer, else the
+    # write number is page-cache bandwidth, not device throughput
+    t0 = time.perf_counter()
+    h.pwrite(buf, path)
+    if not direct:
+        os.sync()
+    t_w = time.perf_counter() - t0
+    # evict this file from the page cache so buffered reads hit the device
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)
+    out = np.empty_like(buf)
+    t0 = time.perf_counter()
+    h.pread(out, path)
+    t_r = time.perf_counter() - t0
+    ok = bool(np.array_equal(out, buf))
+    h.close()
+    return {"block_size": block_size, "threads": threads,
+            "o_direct": direct, "mb": mb,
+            "write_gbps": round(mb / 1024 / t_w, 2),
+            "read_gbps": round(mb / 1024 / t_r, 2),
+            "roundtrip_ok": ok}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=None,
+                    help="target directory (default: a tempdir — use a real "
+                         "NVMe mount for meaningful numbers)")
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--tiny", action="store_true", help="CI smoke (8 MB)")
+    args = ap.parse_args()
+    if args.tiny:
+        args.mb = 8
+
+    d = args.dir or tempfile.mkdtemp(prefix="ds_aio_bench_")
+    points = []
+    blocks = [1 << 20] if args.tiny else [256 << 10, 1 << 20, 8 << 20]
+    threads = [2] if args.tiny else [1, 4, 8]
+    for bs in blocks:
+        for nt in threads:
+            for direct in (False, True):
+                path = os.path.join(d, f"bench_{bs}_{nt}_{int(direct)}.bin")
+                rec = bench_point(path, args.mb, bs, nt, direct)
+                print(json.dumps(rec), flush=True)
+                points.append(rec)
+                os.remove(path)
+    best_w = max(points, key=lambda r: r["write_gbps"])
+    best_r = max(points, key=lambda r: r["read_gbps"])
+    print(json.dumps({"metric": "aio_sweep_best", "dir": d,
+                      "best_write": best_w, "best_read": best_r,
+                      "all_roundtrips_ok": all(p["roundtrip_ok"]
+                                               for p in points)}))
+
+
+if __name__ == "__main__":
+    main()
