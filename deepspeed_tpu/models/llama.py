@@ -40,6 +40,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     attention_impl: str = "xla"  # "xla" | "flash"
+    #: cached single-token attention: "xla" (repeat_kv + full-cache softmax)
+    #: or "pallas" (ops/pallas/decode_attention.py — the softmax_context
+    #: kernel equivalent; streams the cache per kv head, skips unfilled
+    #: blocks)
+    decode_attention_impl: str = "xla"
     # flash kernel tile sizes (VMEM blocks); tuned per chip generation
     flash_block_q: int = 512
     flash_block_k: int = 512
@@ -104,10 +109,21 @@ class LlamaAttention(nn.Module):
             # decode / cached-prefill path (reference: softmax_context KV-cache
             # append, pt_binding.cpp). mask carries the [B, S] key-padding mask.
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
-            k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
-            v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
-            bias = cache_attention_bias(T, k.shape[1], cache_index, key_mask=mask)
-            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+            if T == 1 and cfg.decode_attention_impl == "pallas":
+                # Pallas decode kernel: streams the cache once per kv head
+                # (GQA heads share the pass, no repeat_kv copy) and skips
+                # blocks beyond the filled prefix
+                from ..ops.pallas.decode_attention import decode_attention
+
+                out = decode_attention(q[:, 0], layer_cache["k"],
+                                       layer_cache["v"], cache_index,
+                                       key_mask=mask)[:, None]
+            else:
+                k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
+                v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+                bias = cache_attention_bias(T, k.shape[1], cache_index,
+                                            key_mask=mask)
+                out = dot_product_attention(q, k, v, bias=bias, causal=False)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)

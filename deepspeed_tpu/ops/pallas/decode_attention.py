@@ -1,0 +1,175 @@
+"""Pallas decode attention over a partially-filled KV cache.
+
+Counterpart of the reference's ``softmax_context`` inference kernel
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1286``,
+``softmax_kernels.cu``): single-position attention against the persistent KV
+cache with triangular/padding masking — the hot op of every decode step.
+
+TPU-native design: one Pallas program per (batch row, kv head) streams the
+cache in ``block_k`` chunks with an online softmax; the grouped-query heads
+of a kv head ride the same pass (GQA never materializes repeated K/V — the
+XLA fallback's ``repeat_kv`` copies the cache ``H/Hkv`` times per step). KV
+blocks wholly beyond the filled prefix (``cache_index``) are skipped under
+``pl.when`` — as the cache fills, work grows with the REAL sequence length
+while the XLA path always pays for the full padded cache.
+
+Parity is tested against the engine's XLA decode path in interpret mode
+(CPU) and the kernel is opt-in via ``decode_attention_impl="pallas"`` on the
+model config.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _ceil_div(a, b):
+    return (a + b - 1) // b
+
+
+def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale: float, block_k: int,
+                   s_total: int):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    cidx = cidx_ref[0]
+    # blocks entirely beyond the filled prefix contribute nothing: skip
+    # (compute only grows with the REAL sequence length)
+    @pl.when(ik * block_k <= cidx)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)     # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)     # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)     # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
+        valid = (cols <= cidx) & (cols < s_total)
+        valid = valid & (mask_ref[0] > 0)[None, :]
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:]                        # [G, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # all-masked blocks keep m at -inf; exp(-inf - -inf) guards below
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _reference_decode(q, k_cache, v_cache, cache_index, key_mask, sm_scale):
+    from ...models.layers import (cache_attention_bias,
+                                  dot_product_attention, repeat_kv)
+
+    H, Hkv = q.shape[1], k_cache.shape[2]
+    k = repeat_kv(k_cache.astype(q.dtype), H // Hkv)
+    v = repeat_kv(v_cache.astype(q.dtype), H // Hkv)
+    bias = cache_attention_bias(1, k.shape[1], cache_index, key_mask=key_mask)
+    return dot_product_attention(q[:, None], k, v, bias=bias, causal=False,
+                                 scale=sm_scale)[:, 0]
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, cache_index,
+                     key_mask: Optional[jnp.ndarray] = None,
+                     sm_scale: Optional[float] = None, block_k: int = 256,
+                     interpret: Optional[bool] = None,
+                     force_pallas: bool = False) -> jnp.ndarray:
+    """Single-position cached attention.
+
+    q: ``[B, H, D]`` (the one new token's query heads), k_cache/v_cache:
+    ``[B, S, Hkv, D]``, ``cache_index``: scalar count of already-cached
+    tokens (the new token sits at that position), ``key_mask``: ``[B, S]``
+    1 = real token. Returns ``[B, H, D]``.
+
+    ``interpret=None`` auto-selects: real kernel on TPU, the XLA reference
+    math elsewhere (interpret mode available for kernel-parity tests).
+    """
+    if interpret is None:
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu and not force_pallas:
+            if sm_scale is None:
+                sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+            return _reference_decode(q, k_cache, v_cache, cache_index,
+                                     key_mask, sm_scale)
+        interpret = not on_tpu
+    B, H, D = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    if H % Hkv:
+        raise ValueError(f"query heads {H} must divide into kv heads {Hkv}")
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    bk = min(block_k, S)
+
+    # [B, Hkv, G|S, D] layouts for clean blocking
+    qg = q.reshape(B, Hkv, G, D)
+    kt = jnp.swapaxes(k_cache, 1, 2)            # [B, Hkv, S, D]
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    pad = (-S) % bk
+    if pad:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    key_mask = jnp.pad(key_mask.astype(jnp.int32), ((0, 0), (0, pad)))
+    cidx = jnp.asarray(cache_index, jnp.int32).reshape(1)
+
+    nk = _ceil_div(S, bk)
+
+    # Clamp the K/V/mask block index to the filled prefix: grid steps beyond
+    # cache_index revisit the SAME already-resident block, so Pallas skips
+    # the HBM->VMEM copy — decode bandwidth (the bottleneck) grows with the
+    # REAL sequence length, not the padded cache. Compute for those steps is
+    # skipped by the pl.when in the kernel body.
+    def kv_idx(b, h, ik, cidx_ref):
+        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk), 0)
+
+    def mask_idx(b, h, ik, cidx_ref):
+        return (b, jnp.minimum(ik, cidx_ref[0] // bk))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, D), kv_idx),
+            pl.BlockSpec((1, 1, bk, D), kv_idx),
+            pl.BlockSpec((1, bk), mask_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=bk,
+                          s_total=S),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=interpret,
+    )(cidx, qg, kt, vt, key_mask)
+    return out.reshape(B, H, D)

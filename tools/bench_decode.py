@@ -35,7 +35,8 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_point(batch: int, prompt: int, new: int, tiny: bool) -> dict:
+def run_point(batch: int, prompt: int, new: int, tiny: bool,
+              impl: str = "xla") -> dict:
     import jax
 
     if tiny:
@@ -47,10 +48,11 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool) -> dict:
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
     if tiny:
-        cfg = LlamaConfig.tiny(remat=False)
+        cfg = LlamaConfig.tiny(remat=False, decode_attention_impl=impl)
     else:
         cfg = LlamaConfig.llama_400m(
-            max_position_embeddings=prompt + new, remat=False)
+            max_position_embeddings=prompt + new, remat=False,
+            decode_attention_impl=impl)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
@@ -77,6 +79,10 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool) -> dict:
                   if extra_steps > 0 and dt > ttft else None)
 
     return {
+        "impl": impl,
+        # off-TPU the pallas impl silently falls back to the XLA reference;
+        # record the backend so committed numbers can't mislabel what ran
+        "backend": jax.default_backend(),
         "ttft_ms": round(ttft * 1e3, 1),
         "decode_tokens_per_sec":
             round(decode_tps, 1) if decode_tps else None,
@@ -119,11 +125,14 @@ def main():
     ap.add_argument("--tiny", action="store_true", help="CPU smoke test")
     ap.add_argument("--one", nargs=3, type=int, metavar=("B", "P", "N"),
                     help="child mode: measure a single (batch,prompt,new) point")
+    ap.add_argument("--impl", default="xla", choices=("xla", "pallas"),
+                    help="decode attention: XLA repeat_kv path or the Pallas "
+                         "softmax_context-equivalent kernel")
     args = ap.parse_args()
 
     if args.one:
         b, p, n = args.one
-        print(json.dumps(run_point(b, p, n, args.tiny)), flush=True)
+        print(json.dumps(run_point(b, p, n, args.tiny, args.impl)), flush=True)
         return
 
     probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
@@ -134,7 +143,7 @@ def main():
     points = ([(1, 16, 8), (2, 16, 8)] if args.tiny
               else [(1, 128, 128), (8, 512, 128), (32, 1024, 128)])
 
-    summary = {"metric": "llama400m_decode", "points": []}
+    summary = {"metric": "llama400m_decode", "impl": args.impl, "points": []}
     if not args.tiny:
         log(f"bench_decode: probing backend (deadline {probe_deadline:.0f}s)")
         probe = ("import json, time\nt0 = time.time()\nimport jax\n"
@@ -155,7 +164,8 @@ def main():
     for b, p, n in points:
         tag = f"b{b},p{p},n{n}"
         log(f"bench_decode: point {tag} (cap {point_cap:.0f}s)")
-        argv = ["--one", str(b), str(p), str(n)] + (["--tiny"] if args.tiny else [])
+        argv = ["--one", str(b), str(p), str(n), "--impl", args.impl] \
+            + (["--tiny"] if args.tiny else [])
         rec, why = _run_sub(argv, point_cap)
         if rec is None:
             log(f"bench_decode: {tag} FAILED: {why}")
