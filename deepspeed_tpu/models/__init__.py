@@ -1,4 +1,5 @@
 from .gpt2 import GPT2Config, GPT2LMHeadModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM  # noqa: F401
+from .mixtral import MixtralConfig, MixtralForCausalLM  # noqa: F401
 from .transformer import (TransformerConfig, TransformerForMaskedLM,  # noqa: F401
                           TransformerLMHeadModel)

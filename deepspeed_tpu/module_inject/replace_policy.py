@@ -660,6 +660,83 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
         return leaves
 
 
+class HFMixtralLayerPolicy(DSPolicy):
+    """HF ``MixtralForCausalLM`` → ``models.mixtral.MixtralForCausalLM``
+    (sparse-MoE decoder; expert weights stacked ``[E, ...]`` so they shard
+    over the ``expert`` mesh axis). Routing semantics are HF-exact (top-k of
+    the softmax, renormalized), so logits parity holds token-for-token."""
+
+    hf_model_types = ("MixtralForCausalLM", "mixtral", "MixtralModel")
+
+    def convert(self, hf_model, scan_layers: bool = True):
+        HFLlamaLayerPolicy._check_window(hf_model.config)
+        sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
+        return self.convert_state_dict(hf_model.config, sd, scan_layers)
+
+    @classmethod
+    def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
+        from ..models.mixtral import MixtralConfig, MixtralForCausalLM
+
+        HFLlamaLayerPolicy._check_window(hc)
+        cfg = MixtralConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            num_key_value_heads=hc.num_key_value_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            rms_norm_eps=hc.rms_norm_eps,
+            rope_theta=getattr(hc, "rope_theta", 1e6),
+            num_local_experts=hc.num_local_experts,
+            num_experts_per_tok=hc.num_experts_per_tok,
+            router_aux_loss_coef=getattr(hc, "router_aux_loss_coef", 0.02),
+            tie_word_embeddings=getattr(hc, "tie_word_embeddings", False),
+            scan_layers=scan_layers, remat=False)
+        pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+
+        params: Dict[str, Any] = {}
+        _set(params, "model/embed_tokens/embedding",
+             sd[f"{pfx}embed_tokens.weight"])
+        _set(params, "model/norm/scale", sd[f"{pfx}norm.weight"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+
+        E = cfg.num_local_experts
+
+        def layer_leaves(i):
+            p = f"{pfx}layers.{i}."
+            leaves = {
+                "input_layernorm/scale": sd[f"{p}input_layernorm.weight"],
+                "post_attention_layernorm/scale":
+                    sd[f"{p}post_attention_layernorm.weight"],
+                "block_sparse_moe/gate/kernel":
+                    sd[f"{p}block_sparse_moe.gate.weight"].T,
+            }
+            for hf, fx in [("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                           ("v_proj", "v_proj"), ("o_proj", "o_proj")]:
+                leaves[f"self_attn/{fx}/kernel"] = \
+                    sd[f"{p}self_attn.{hf}.weight"].T
+            # experts: HF w1 (gate, [I, H]), w3 (up, [I, H]), w2 (down,
+            # [H, I]) → stacked flax [E, H, I] / [E, I, H]
+            for w in ("w1", "w3"):
+                leaves[f"block_sparse_moe/{w}"] = np.stack(
+                    [sd[f"{p}block_sparse_moe.experts.{e}.{w}.weight"].T
+                     for e in range(E)])
+            leaves["block_sparse_moe/w2"] = np.stack(
+                [sd[f"{p}block_sparse_moe.experts.{e}.w2.weight"].T
+                 for e in range(E)])
+            return leaves
+
+        _stack_layers(params, cfg.num_hidden_layers, layer_leaves, scan_layers)
+        return MixtralForCausalLM(cfg), params
+
+    @staticmethod
+    def partition_rules(config):
+        from ..models.mixtral import MixtralForCausalLM
+
+        return MixtralForCausalLM.partition_rules(config)
+
+
 class MegatronLayerPolicy(_GenericTransformerPolicy):
     """Megatron-LM GPT → generic decoder (reference ``replace_policy.py:281``
     ``MegatronLayerPolicy`` targets ``ParallelTransformerLayer``; here the
@@ -793,6 +870,7 @@ class MegatronLayerPolicy(_GenericTransformerPolicy):
 
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy,
+                                HFMixtralLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
                                 HFGPTJLayerPolicy, HFGPTNeoLayerPolicy]
