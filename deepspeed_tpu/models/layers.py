@@ -94,7 +94,8 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
                           attention_impl: str = "xla", dropout_rng=None,
                           dropout_rate: float = 0.0, deterministic: bool = True,
                           scale: Optional[float] = None,
-                          flash_block_q: int = 512, flash_block_k: int = 512):
+                          flash_block_q: int = 512, flash_block_k: int = 512,
+                          window: Optional[int] = None):
     """[B, T, H, D] attention core.
 
     ``attention_impl='flash'`` routes to the Pallas flash-attention kernel
@@ -112,7 +113,12 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, sm_scale=scale,
-                               block_q=flash_block_q, block_k=flash_block_k)
+                               block_q=flash_block_q, block_k=flash_block_k,
+                               window=window)
+    if window is not None and attention_impl in ("ulysses", "ring"):
+        raise NotImplementedError(
+            f"sliding-window attention is not composed with "
+            f"attention_impl={attention_impl!r} yet; use 'flash' or 'xla'")
     if attention_impl == "ulysses":
         if scale is not None:
             raise NotImplementedError(
@@ -147,6 +153,12 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
     if causal:
         logits = logits + make_causal_mask(q.shape[1], k.shape[1], dtype=jnp.float32,
                                            offset=k.shape[1] - q.shape[1])[None, None]
+    if window is not None:
+        Tq, Tk = q.shape[1], k.shape[1]
+        i = jnp.arange(Tq)[:, None]
+        j = jnp.arange(Tk)[None, :]
+        logits = jnp.where((i + (Tk - Tq) - j < window)[None, None],
+                           logits, -1e9)
     if bias is not None:
         logits = logits + bias
     logits = logits.astype(jnp.float32)
@@ -183,18 +195,23 @@ def update_kv_cache(layer_cache, k, v, cache_index):
 
 
 def cache_attention_bias(q_len: int, cache_len: int, cache_index,
-                         key_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                         key_mask: Optional[jnp.ndarray] = None,
+                         window: Optional[int] = None) -> jnp.ndarray:
     """Additive bias for attention over a partially-filled KV cache.
 
     Query t sits at absolute position ``cache_index + t``; key j is visible iff
-    ``j <= cache_index + t`` (this covers both causal prefill and decode).
-    ``key_mask`` ``[B, S]`` (1 = real token) additionally hides padding.
-    Counterpart of the triangular masking in the reference's
-    ``softmax_context`` inference kernel.
+    ``j <= cache_index + t`` (this covers both causal prefill and decode) and,
+    with ``window`` (Mistral sliding-window), additionally
+    ``(cache_index + t) - j < window``. ``key_mask`` ``[B, S]`` (1 = real
+    token) additionally hides padding. Counterpart of the triangular masking
+    in the reference's ``softmax_context`` inference kernel.
     """
     q_pos = cache_index + jnp.arange(q_len)
     kv_pos = jnp.arange(cache_len)
-    bias = jnp.where(q_pos[:, None] >= kv_pos[None, :], 0.0, -1e9)[None, None]
+    visible = q_pos[:, None] >= kv_pos[None, :]
+    if window is not None:
+        visible = visible & (q_pos[:, None] - kv_pos[None, :] < window)
+    bias = jnp.where(visible, 0.0, -1e9)[None, None]
     if key_mask is not None:
         bias = bias + jnp.where(key_mask > 0, 0.0, -1e9)[:, None, None, :]
     return bias.astype(jnp.float32)

@@ -39,6 +39,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    #: Mistral-style sliding-window attention: query i attends keys j with
+    #: 0 <= i - j < window (None = full causal)
+    sliding_window: Optional[int] = None
     attention_impl: str = "xla"  # "xla" | "flash"
     #: cached single-token attention: "xla" (repeat_kv + full-cache softmax)
     #: or "pallas" (ops/pallas/decode_attention.py — the softmax_context
@@ -117,20 +120,26 @@ class LlamaAttention(nn.Module):
 
                 out = decode_attention(q[:, 0], layer_cache["k"],
                                        layer_cache["v"], cache_index,
-                                       key_mask=mask)[:, None]
+                                       key_mask=mask,
+                                       window=cfg.sliding_window)[:, None]
             else:
                 k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
                 v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
                 bias = cache_attention_bias(T, k.shape[1], cache_index,
-                                            key_mask=mask)
+                                            key_mask=mask,
+                                            window=cfg.sliding_window)
                 out = dot_product_attention(q, k, v, bias=bias, causal=False)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)
+            # Mistral windowed causality (0 <= i - j < window) threads into
+            # the attention core: the flash kernel masks AND block-skips by
+            # it (O(T*window) work), the xla path applies it on the logits
             out = dot_product_attention(q, k, v, bias=mask, causal=True,
                                         attention_impl=cfg.attention_impl,
                                         flash_block_q=cfg.flash_block_q,
-                                        flash_block_k=cfg.flash_block_k)
+                                        flash_block_k=cfg.flash_block_k,
+                                        window=cfg.sliding_window)
         out = out.reshape(B, T, H * D)
         return dense(cfg.hidden_size, "o_proj")(out), layer_cache
 

@@ -150,21 +150,15 @@ class HFLlamaLayerPolicy(DSPolicy):
     ]
 
     @staticmethod
-    def _check_window(hc):
-        # Mistral-style sliding-window attention is not modelled by the
-        # converted LlamaConfig; silently dropping the window would make long
-        # sequences diverge from HF, so refuse when it is actually binding.
+    def _window(hc):
+        """Mistral-style sliding window, None when not binding (the model's
+        windowed-causality path only engages when set)."""
         window = getattr(hc, "sliding_window", None)
         if window is not None and window < hc.max_position_embeddings:
-            raise NotImplementedError(
-                f"{getattr(hc, 'architectures', None)} uses sliding-window "
-                f"attention (window={window} < max_position_embeddings="
-                f"{hc.max_position_embeddings}), which the converted model "
-                "does not implement; conversion would silently diverge for "
-                "sequences longer than the window")
+            return int(window)
+        return None
 
     def convert(self, hf_model, scan_layers: bool = True):
-        self._check_window(hf_model.config)
         sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
         return self.convert_state_dict(hf_model.config, sd, scan_layers)
 
@@ -172,8 +166,8 @@ class HFLlamaLayerPolicy(DSPolicy):
     def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
         from ..models.llama import LlamaConfig, LlamaForCausalLM
 
-        cls._check_window(hc)
         cfg = LlamaConfig(
+            sliding_window=cls._window(hc),
             vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
             intermediate_size=hc.intermediate_size,
             num_hidden_layers=hc.num_hidden_layers,
@@ -669,7 +663,6 @@ class HFMixtralLayerPolicy(DSPolicy):
     hf_model_types = ("MixtralForCausalLM", "mixtral", "MixtralModel")
 
     def convert(self, hf_model, scan_layers: bool = True):
-        HFLlamaLayerPolicy._check_window(hf_model.config)
         sd = {k: _to_numpy(v) for k, v in hf_model.state_dict().items()}
         return self.convert_state_dict(hf_model.config, sd, scan_layers)
 
@@ -677,8 +670,8 @@ class HFMixtralLayerPolicy(DSPolicy):
     def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
         from ..models.mixtral import MixtralConfig, MixtralForCausalLM
 
-        HFLlamaLayerPolicy._check_window(hc)
         cfg = MixtralConfig(
+            sliding_window=HFLlamaLayerPolicy._window(hc),
             vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
             intermediate_size=hc.intermediate_size,
             num_hidden_layers=hc.num_hidden_layers,

@@ -35,7 +35,7 @@ def _ceil_div(a, b):
 
 def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, sm_scale: float, block_k: int,
-                   s_total: int):
+                   s_total: int, window):
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -46,9 +46,14 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     cidx = cidx_ref[0]
-    # blocks entirely beyond the filled prefix contribute nothing: skip
-    # (compute only grows with the REAL sequence length)
-    @pl.when(ik * block_k <= cidx)
+    # skip blocks entirely beyond the filled prefix AND (with a sliding
+    # window) blocks entirely below it: compute grows with
+    # min(real length, window)
+    run = ik * block_k <= cidx
+    if window is not None:
+        run = run & ((ik + 1) * block_k > cidx - window)
+
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)     # [G, D]
         k = k_ref[0, 0].astype(jnp.float32)     # [bk, D]
@@ -57,6 +62,8 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
         valid = (cols <= cidx) & (cols < s_total)
+        if window is not None:  # Mistral sliding window: cidx - j < window
+            valid = valid & (cidx - cols < window)
         valid = valid & (mask_ref[0] > 0)[None, :]
         s = jnp.where(valid, s, NEG_INF)
 
@@ -78,14 +85,16 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
-def _reference_decode(q, k_cache, v_cache, cache_index, key_mask, sm_scale):
+def _reference_decode(q, k_cache, v_cache, cache_index, key_mask, sm_scale,
+                      window=None):
     from ...models.layers import (cache_attention_bias,
                                   dot_product_attention, repeat_kv)
 
     H, Hkv = q.shape[1], k_cache.shape[2]
     k = repeat_kv(k_cache.astype(q.dtype), H // Hkv)
     v = repeat_kv(v_cache.astype(q.dtype), H // Hkv)
-    bias = cache_attention_bias(1, k.shape[1], cache_index, key_mask=key_mask)
+    bias = cache_attention_bias(1, k.shape[1], cache_index, key_mask=key_mask,
+                                window=window)
     return dot_product_attention(q[:, None], k, v, bias=bias, causal=False,
                                  scale=sm_scale)[:, 0]
 
@@ -95,7 +104,8 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      key_mask: Optional[jnp.ndarray] = None,
                      sm_scale: Optional[float] = None, block_k: int = 256,
                      interpret: Optional[bool] = None,
-                     force_pallas: bool = False) -> jnp.ndarray:
+                     force_pallas: bool = False,
+                     window: Optional[int] = None) -> jnp.ndarray:
     """Single-position cached attention.
 
     q: ``[B, H, D]`` (the one new token's query heads), k_cache/v_cache:
@@ -112,7 +122,7 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
             if sm_scale is None:
                 sm_scale = 1.0 / (q.shape[-1] ** 0.5)
             return _reference_decode(q, k_cache, v_cache, cache_index,
-                                     key_mask, sm_scale)
+                                     key_mask, sm_scale, window=window)
         interpret = not on_tpu
     B, H, D = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -167,7 +177,7 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=bk,
-                          s_total=S),
+                          s_total=S, window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         interpret=interpret,

@@ -40,7 +40,7 @@ def _ceil_div(a, b):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 sm_scale: float, causal: bool, block_q: int, block_k: int,
-                tq: int, tk: int):
+                tq: int, tk: int, window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -56,6 +56,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     run = True
     if causal:
         run = ik * block_k <= iq * block_q + block_q - 1 + (tk - tq)
+    if window is not None:
+        # kv block wholly below the sliding window of every q row: skip
+        run = run & (ik * block_k + block_k - 1 + window >
+                     iq * block_q + (tk - tq))
 
     @pl.when(run)
     def _body():
@@ -70,6 +74,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         valid = (cols < tk) & (rows < tq)
         if causal:
             valid = valid & (rows + (tk - tq) >= cols)
+        if window is not None:
+            valid = valid & (rows + (tk - tq) - cols < window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                       # [bq, 1]
@@ -101,7 +107,8 @@ def _pad_seq(x, block):
     return x
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               window=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
@@ -112,7 +119,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, tq=Tq, tk=Tk),
+                          block_q=bq, block_k=bk, tq=Tq, tk=Tk,
+                          window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -144,7 +152,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
                    sm_scale: float, causal: bool, block_q: int, block_k: int,
-                   tq: int, tk: int):
+                   tq: int, tk: int, window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -155,6 +163,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
     run = True
     if causal:
         run = ik * block_k <= iq * block_q + block_q - 1 + (tk - tq)
+    if window is not None:
+        run = run & (ik * block_k + block_k - 1 + window >
+                     iq * block_q + (tk - tq))
 
     @pl.when(run)
     def _body():
@@ -171,6 +182,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         valid = (cols < tk) & (rows < tq)
         if causal:
             valid = valid & (rows + (tk - tq) >= cols)
+        if window is not None:
+            valid = valid & (rows + (tk - tq) - cols < window)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -185,7 +198,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                     dk_scr, dv_scr, *, sm_scale: float, causal: bool, block_q: int,
-                    block_k: int, tq: int, tk: int):
+                    block_k: int, tq: int, tk: int, window):
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -198,6 +211,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     if causal:
         # q block fully above the diagonal contributes nothing to this kv block
         run = iq * block_q + block_q - 1 + (tk - tq) >= ik * block_k
+    if window is not None:
+        # q block whose window lies wholly past this kv block: skip
+        run = run & (ik * block_k + block_k - 1 + window >
+                     iq * block_q + (tk - tq))
 
     @pl.when(run)
     def _body():
@@ -215,6 +232,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         valid = (cols < tk) & (rows < tq)
         if causal:
             valid = valid & (rows + (tk - tq) >= cols)
+        if window is not None:
+            valid = valid & (rows + (tk - tq) - cols < window)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)                    # [bq, bk]
         p = jnp.where(rows < tq, p, 0.0)
@@ -232,7 +251,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret,
+               window=None):
     q, k, v, out, lse = res
     do = g
     B, H, Tq, D = q.shape
@@ -253,7 +273,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, tq=Tq, tk=Tk),
+                          block_q=bq, block_k=bk, tq=Tq, tk=Tk,
+                          window=window),
         grid=(B, H, Tq_p // bq, Tk_p // bk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -271,7 +292,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, tq=Tq, tk=Tk),
+                          block_q=bq, block_k=bk, tq=Tq, tk=Tk,
+                          window=window),
         grid=(B, H, Tk_p // bk, Tq_p // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, ik, iq: (b, h, iq, 0)),
@@ -303,38 +325,49 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhtd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_bhtd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                          window=None):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                        window)
     return out
 
 
-def _vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+             window=None):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
+def _vjp_bwd(sm_scale, causal, block_q, block_k, interpret, window, res, g):
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret,
+                      window)
 
 
 _flash_attention_bhtd.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def _reference_attention(q, k, v, causal, sm_scale):
+def _reference_attention(q, k, v, causal, sm_scale, window=None):
     """[B,T,H,D] einsum reference (used on non-TPU backends)."""
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    Tq, Tk = q.shape[1], k.shape[1]
     if causal:
-        Tq, Tk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if window is not None:
+        i = jnp.arange(Tq)[:, None]
+        j = jnp.arange(Tk)[None, :]
+        wmask = (i + (Tk - Tq) - j) < window
+        logits = jnp.where(wmask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512,
-                    interpret: Optional[bool] = None, force_pallas: bool = False):
+                    interpret: Optional[bool] = None, force_pallas: bool = False,
+                    window: Optional[int] = None):
     """Flash attention over [B, T, H, D] tensors.
 
     ``interpret=None`` auto-selects: real kernel on TPU, reference math
@@ -345,11 +378,13 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     if interpret is None:
         on_tpu = jax.default_backend() == "tpu"
         if not on_tpu and not force_pallas:
-            return _reference_attention(q, k, v, causal, sm_scale)
+            return _reference_attention(q, k, v, causal, sm_scale,
+                                        window=window)
         interpret = not on_tpu
 
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash_attention_bhtd(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
+    out = _flash_attention_bhtd(qt, kt, vt, sm_scale, causal, block_q, block_k,
+                                interpret, window)
     return jnp.transpose(out, (0, 2, 1, 3))

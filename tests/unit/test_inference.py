@@ -231,21 +231,23 @@ def test_int8_dtype_auto_enables_quantize():
     assert agree > 0.7, f"int8 argmax agreement too low: {agree}"
 
 
-def test_mistral_sliding_window_rejected():
-    """A binding sliding window cannot be represented by the converted model;
-    conversion must refuse rather than silently diverge (ADVICE r1)."""
+def test_sliding_window_config_detection():
+    """_window() reports a binding sliding window and ignores a non-binding
+    one (r3: windowed attention is modelled, so conversion proceeds with
+    cfg.sliding_window set instead of refusing — see
+    test_mistral_sliding_window_parity_and_generate)."""
     import types
 
     from deepspeed_tpu.module_inject.replace_policy import HFLlamaLayerPolicy
 
-    config = types.SimpleNamespace(
-        sliding_window=128, max_position_embeddings=2048, vocab_size=256,
-        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
-        num_attention_heads=4, rms_norm_eps=1e-6)
-    fake = type("MistralForCausalLM", (), {})()
-    fake.config = config
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        HFLlamaLayerPolicy().convert(fake)
+    binding = types.SimpleNamespace(sliding_window=128,
+                                    max_position_embeddings=2048)
+    assert HFLlamaLayerPolicy._window(binding) == 128
+    loose = types.SimpleNamespace(sliding_window=4096,
+                                  max_position_embeddings=2048)
+    assert HFLlamaLayerPolicy._window(loose) is None
+    absent = types.SimpleNamespace(max_position_embeddings=2048)
+    assert HFLlamaLayerPolicy._window(absent) is None
 
 
 # ---------------------------------------------------------------------------
@@ -389,3 +391,34 @@ def test_profile_model_time_collects_latencies():
     times = engine.model_times()
     assert len(times) == 2 and all(t > 0 for t in times)
     assert engine.model_times() == []  # reset after read
+
+
+def test_mistral_sliding_window_parity_and_generate():
+    """Windowed Mistral converts (r3: window modelled, not refused) and
+    matches HF logits + greedy tokens for sequences past the window."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+
+    torch.manual_seed(0)
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8, attention_dropout=0.0)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    model, params = replace_transformer_layer(hf)
+    assert model.config.sliding_window == 8
+
+    ids = np.random.RandomState(9).randint(0, 128, (2, 20))
+    with torch.no_grad():
+        ref_logits = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref_logits, rtol=2e-3, atol=2e-3)
+
+    engine = ds.init_inference(hf, dtype="fp32")
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()[:, 20:]
+    got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(got, ref)

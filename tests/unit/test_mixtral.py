@@ -122,7 +122,10 @@ def test_training_loss_matches_hf_including_aux():
     np.testing.assert_allclose(float(ours), float(out.loss), rtol=2e-3)
 
 
-def test_sliding_window_model_rejected():
+def test_sliding_window_logits_parity():
+    """Windowed Mixtral (sliding_window < seq len) converts and matches HF
+    logits for sequences LONGER than the window (r3: the window is modelled,
+    not refused)."""
     transformers = pytest.importorskip("transformers")
     torch = pytest.importorskip("torch")
     from deepspeed_tpu.module_inject import replace_transformer_layer
@@ -132,7 +135,13 @@ def test_sliding_window_model_rejected():
         vocab_size=128, hidden_size=32, intermediate_size=64,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=64, num_local_experts=4,
-        num_experts_per_tok=2, sliding_window=16)
+        num_experts_per_tok=2, sliding_window=8, attention_dropout=0.0)
     hf = transformers.MixtralForCausalLM(cfg).eval()
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        replace_transformer_layer(hf)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.sliding_window == 8
+
+    ids = np.random.RandomState(7).randint(0, 128, (2, 24))  # 3x the window
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
