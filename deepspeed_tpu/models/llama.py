@@ -42,6 +42,8 @@ class LlamaConfig:
     #: Mistral-style sliding-window attention: query i attends keys j with
     #: 0 <= i - j < window (None = full causal)
     sliding_window: Optional[int] = None
+    #: Qwen2-style: biases on q/k/v projections (o/mlp stay bias-free)
+    attention_qkv_bias: bool = False
     attention_impl: str = "xla"  # "xla" | "flash"
     #: cached single-token attention: "xla" (repeat_kv + full-cache softmax)
     #: or "pallas" (ops/pallas/decode_attention.py — the softmax_context
@@ -101,11 +103,12 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         B, T, _ = x.shape
         H, Hkv, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name,
-                                             param_dtype=jnp.float32)
-        q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
-        k = dense(Hkv * D, "k_proj")(x).reshape(B, T, Hkv, D)
-        v = dense(Hkv * D, "v_proj")(x).reshape(B, T, Hkv, D)
+        dense = lambda feats, name, bias=False: nn.Dense(
+            feats, use_bias=bias, name=name, param_dtype=jnp.float32)
+        qb = cfg.attention_qkv_bias
+        q = dense(H * D, "q_proj", qb)(x).reshape(B, T, H, D)
+        k = dense(Hkv * D, "k_proj", qb)(x).reshape(B, T, Hkv, D)
+        v = dense(Hkv * D, "v_proj", qb)(x).reshape(B, T, Hkv, D)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         if layer_cache is not None:

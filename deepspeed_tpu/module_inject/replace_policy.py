@@ -136,6 +136,8 @@ class HFLlamaLayerPolicy(DSPolicy):
 
     hf_model_types = ("LlamaForCausalLM", "llama", "LlamaModel", "MistralForCausalLM",
                       "mistral")
+    #: Qwen2 subclass flips this: q/k/v carry biases (o/mlp stay bias-free)
+    QKV_BIAS = False
 
     LAYER_MAP = [
         ("input_layernorm.weight", "input_layernorm/scale", False),
@@ -178,6 +180,7 @@ class HFLlamaLayerPolicy(DSPolicy):
             rms_norm_eps=hc.rms_norm_eps,
             rope_theta=getattr(hc, "rope_theta", 10000.0),
             tie_word_embeddings=getattr(hc, "tie_word_embeddings", False),
+            attention_qkv_bias=cls.QKV_BIAS,
             scan_layers=scan_layers, remat=False)
         pfx = "model." if any(k.startswith("model.") for k in sd) else ""
 
@@ -187,18 +190,23 @@ class HFLlamaLayerPolicy(DSPolicy):
         if not cfg.tie_word_embeddings:
             _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
 
+        layer_map = list(cls.LAYER_MAP)
+        if cls.QKV_BIAS:
+            layer_map += [(f"self_attn.{p}.bias", f"self_attn/{p}/bias", False)
+                          for p in ("q_proj", "k_proj", "v_proj")]
+
         def layer_leaf(i, suffix, transpose):
             w = sd[f"{pfx}layers.{i}.{suffix}"]
             return w.T if transpose else w
 
         if scan_layers:
-            for suffix, path, tr in cls.LAYER_MAP:
+            for suffix, path, tr in layer_map:
                 stacked = np.stack([layer_leaf(i, suffix, tr)
                                     for i in range(cfg.num_hidden_layers)])
                 _set(params, f"model/layers/block/{path}", stacked)
         else:
             for i in range(cfg.num_hidden_layers):
-                for suffix, path, tr in cls.LAYER_MAP:
+                for suffix, path, tr in layer_map:
                     _set(params, f"model/layers_{i}/{path}", layer_leaf(i, suffix, tr))
         return LlamaForCausalLM(cfg), params
 
@@ -654,6 +662,33 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
         return leaves
 
 
+class HFQwen2LayerPolicy(HFLlamaLayerPolicy):
+    """HF ``Qwen2ForCausalLM`` → the Llama graph with QKV biases (the only
+    architectural delta; Qwen2's sliding window binds only when
+    ``use_sliding_window`` is set)."""
+
+    hf_model_types = ("Qwen2ForCausalLM", "qwen2", "Qwen2Model")
+    QKV_BIAS = True
+
+    @staticmethod
+    def _window(hc):
+        if not getattr(hc, "use_sliding_window", False):
+            return None
+        # HF Qwen2 windows only layers i >= max_window_layers; this model
+        # applies ONE global window, so a mixed split must refuse rather
+        # than silently window the full-attention layers
+        mwl = int(getattr(hc, "max_window_layers", 0) or 0)
+        if mwl >= hc.num_hidden_layers:
+            return None  # no layer actually slides
+        if mwl > 0:
+            raise NotImplementedError(
+                f"Qwen2 per-layer sliding gating (max_window_layers={mwl} < "
+                f"num_hidden_layers={hc.num_hidden_layers}) mixes full and "
+                "windowed layers, which the converted model's single global "
+                "window cannot represent")
+        return HFLlamaLayerPolicy._window(hc)
+
+
 class HFMixtralLayerPolicy(DSPolicy):
     """HF ``MixtralForCausalLM`` → ``models.mixtral.MixtralForCausalLM``
     (sparse-MoE decoder; expert weights stacked ``[E, ...]`` so they shard
@@ -862,8 +897,8 @@ class MegatronLayerPolicy(_GenericTransformerPolicy):
 
 
 #: All registered policies (reference: ``replace_policies`` list)
-generic_policies: List[type] = [HFGPT2LayerPolicy, HFLlamaLayerPolicy,
-                                HFMixtralLayerPolicy,
+generic_policies: List[type] = [HFGPT2LayerPolicy, HFQwen2LayerPolicy,
+                                HFLlamaLayerPolicy, HFMixtralLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
                                 HFGPTJLayerPolicy, HFGPTNeoLayerPolicy]

@@ -422,3 +422,37 @@ def test_mistral_sliding_window_parity_and_generate():
                           do_sample=False, pad_token_id=0).numpy()[:, 20:]
     got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_qwen2_logits_and_generate_parity():
+    """Qwen2 = Llama graph + QKV biases; tied-embedding variant included."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import match_policy
+
+    for tie in (False, True):
+        torch.manual_seed(0)
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=tie,
+            attention_dropout=0.0)
+        hf = transformers.Qwen2ForCausalLM(cfg).eval()
+        assert type(match_policy(hf)).__name__ == "HFQwen2LayerPolicy"
+        engine = ds.init_inference(hf, dtype="fp32")
+        assert engine.module.config.attention_qkv_bias
+
+        ids = np.random.RandomState(11).randint(0, 128, (2, 10))
+        with torch.no_grad():
+            ref_logits = hf(torch.tensor(ids)).logits.numpy()
+        ours = np.asarray(engine.module.apply({"params": engine.params},
+                                              jnp.asarray(ids)))
+        np.testing.assert_allclose(ours, ref_logits, rtol=2e-3, atol=2e-3)
+
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                              do_sample=False, pad_token_id=0).numpy()[:, 10:]
+        got = np.asarray(engine.generate(ids, max_new_tokens=6,
+                                         do_sample=False))
+        np.testing.assert_array_equal(got, ref)
