@@ -1,0 +1,107 @@
+"""One-command on-chip evidence sweep.
+
+The round-2/3 failure mode was a TPU backend that stayed unreachable for an
+entire round: every measurement window that DID open had to be spent
+rediscovering which tool to run. This orchestrator captures the full
+perf-evidence set in one go, the moment the chip answers:
+
+  1. probe (<=60 s subprocess deadline — a down backend exits immediately)
+  2. tools/profile_train.py      → PROFILE_<tag>.json   (step breakdown)
+  3. bench.py                    → BENCH_<tag>.json     (headline TFLOPs)
+  4. tools/bench_decode.py       → DECODE_<tag>.json    (TTFT + decode t/s,
+     xla AND pallas decode-attention impls)
+  5. tools/bench_infinity.py     → INFINITY_<tag>.json  (streaming overlap)
+  6. tools/bench_longctx.py      → LONGCTX_<tag>.json   (flash vs sparse)
+
+Every step runs in a capped subprocess; a failure records the error and the
+sweep continues. All artifacts land in the repo root ready to commit.
+
+Usage: python tools/chip_sweep.py [--tag r03] [--skip profile,longctx,...]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_capped(cmd, cap_s, out_path=None):
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=cap_s,
+                           cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timeout after {cap_s:.0f}s"}
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+    rec = {"ok": r.returncode == 0 and bool(lines),
+           "elapsed_s": round(time.time() - t0, 1)}
+    if not rec["ok"]:
+        rec["error"] = (r.stderr.strip().splitlines() or ["no output"])[-1][:300]
+    if lines and out_path:
+        with open(os.path.join(REPO, out_path), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        rec["artifact"] = out_path
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", default="r03")
+    ap.add_argument("--skip", default="",
+                    help="comma list: profile,bench,decode,infinity,longctx")
+    ap.add_argument("--probe_s", type=float, default=60.0)
+    args = ap.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+    py = sys.executable
+
+    log(f"chip_sweep: probing backend ({args.probe_s:.0f}s deadline)")
+    probe = ("import json, time\nt0=time.time()\nimport jax\n"
+             "d=jax.devices()\nprint(json.dumps({'n': len(d), "
+             "'kind': str(d[0]), 'init_s': round(time.time()-t0,1)}))\n")
+    try:
+        r = subprocess.run([py, "-c", probe], capture_output=True, text=True,
+                           timeout=args.probe_s)
+        up = r.returncode == 0 and "{" in r.stdout
+    except subprocess.TimeoutExpired:
+        up = False
+    if not up:
+        print(json.dumps({"metric": "chip_sweep", "tag": args.tag,
+                          "backend": "unavailable", "steps": {}}), flush=True)
+        return 1
+    log(f"chip_sweep: backend UP: {r.stdout.strip()}")
+
+    t = args.tag
+    steps = {}
+    plan = [
+        ("profile", [py, "tools/profile_train.py", "--quick"], 1500,
+         f"PROFILE_{t}.json"),
+        ("bench", [py, "bench.py"], 1800, f"BENCH_{t}_local.json"),
+        ("decode", [py, "tools/bench_decode.py"], 1500, f"DECODE_{t}.json"),
+        ("decode_pallas", [py, "tools/bench_decode.py", "--impl", "pallas"],
+         1500, f"DECODE_{t}_pallas.json"),
+        ("infinity", [py, "tools/bench_infinity.py"], 900,
+         f"INFINITY_{t}_chip.json"),
+        ("longctx", [py, "tools/bench_longctx.py"], 1200,
+         f"LONGCTX_{t}.json"),
+    ]
+    for name, cmd, cap, artifact in plan:
+        if name.split("_")[0] in skip:
+            continue
+        log(f"chip_sweep: {name} (cap {cap}s)")
+        steps[name] = run_capped(cmd, cap, artifact)
+        log(f"chip_sweep: {name}: {steps[name]}")
+    print(json.dumps({"metric": "chip_sweep", "tag": args.tag,
+                      "backend": "up", "steps": steps}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
