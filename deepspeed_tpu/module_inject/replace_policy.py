@@ -662,6 +662,143 @@ class HFGPTNeoLayerPolicy(_GenericTransformerPolicy):
         return leaves
 
 
+class HFFalconLayerPolicy(_GenericTransformerPolicy):
+    """HF ``FalconForCausalLM`` → generic decoder: rotary, parallel
+    attention+MLP behind ONE shared layernorm (falcon-7b ``parallel_attn``),
+    multi-query or grouped KV, bias-free projections, tied embeddings.
+
+    Fused QKV layouts (HF falcon modeling):
+    - classic multi_query (7b): rows ``[Q(all heads); K(1); V(1)]``
+    - new_decoder_architecture (40b/180b): per-kv-group interleaved
+      ``[q_per_group x D; K x D; V x D] x num_kv``
+    """
+
+    hf_model_types = ("FalconForCausalLM", "falcon", "FalconModel")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        if getattr(hc, "alibi", False):
+            raise NotImplementedError("Falcon alibi variants are not mapped "
+                                      "(falcon-7b/40b/180b use rotary)")
+        if not getattr(hc, "parallel_attn", True):
+            raise NotImplementedError("Falcon without parallel_attn (RW "
+                                      "prototype configs) is not mapped")
+        if getattr(hc, "new_decoder_architecture", False):
+            kv = hc.num_kv_heads
+        else:
+            kv = 1 if getattr(hc, "multi_query", True) else hc.num_attention_heads
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=getattr(hc, "ffn_hidden_size",
+                                      4 * hc.hidden_size),
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            num_key_value_heads=kv,
+            max_position_embeddings=getattr(hc, "max_position_embeddings",
+                                            2048),
+            pos_embedding="rope",
+            rope_theta=getattr(hc, "rope_theta", 10000.0),
+            parallel_residual=True,
+            # mirrors FalconDecoderLayer.__init__: two LNs (ln_attn for
+            # attention, ln_mlp for the MLP) only when the new architecture
+            # runs with num_ln_in_parallel_attn == 2 (its default); falcon2-
+            # 11B sets it to 1 and keeps the shared input_layernorm
+            shared_parallel_ln=not cls._two_ln(hc),
+            activation="gelu", norm_eps=hc.layer_norm_epsilon,
+            pre_layernorm=True,
+            attention_bias=bool(getattr(hc, "bias", False)),
+            mlp_bias=bool(getattr(hc, "bias", False)),
+            tie_word_embeddings=getattr(hc, "tie_word_embeddings", True),
+            scan_layers=scan_layers)
+
+    @staticmethod
+    def _two_ln(hc) -> bool:
+        if not getattr(hc, "new_decoder_architecture", False):
+            return False
+        n = getattr(hc, "num_ln_in_parallel_attn", None)
+        return n is None or n == 2  # HF defaults None -> 2 under new arch
+
+    @classmethod
+    def _split_falcon_qkv(cls, w, hc, cfg):
+        """→ (q, k, v) with rows split per the HF fused layout; works for
+        both kernels ([rows, in]) and biases ([rows])."""
+        D = cfg.head_dim
+        H = cfg.num_attention_heads
+        tail = w.shape[1:]
+        if getattr(hc, "new_decoder_architecture", False):
+            # per-kv-group interleaved: [q_per_group; K; V] x num_kv
+            kv = hc.num_kv_heads
+            g = H // kv
+            w = w.reshape((kv, g + 2, D) + tail)
+            q = w[:, :g].reshape((H * D,) + tail)
+            k = w[:, g].reshape((kv * D,) + tail)
+            v = w[:, g + 1].reshape((kv * D,) + tail)
+        elif getattr(hc, "multi_query", True):
+            # classic MQA: [Q(all heads); K(1); V(1)] contiguous rows
+            q, k, v = np.split(w, [H * D, (H + 1) * D], axis=0)
+        else:
+            # classic MHA: per-head interleaved [H, 3, D] rows (HF
+            # _split_heads views fused_qkv as (..., heads, 3, head_dim))
+            w = w.reshape((H, 3, D) + tail)
+            q = w[:, 0].reshape((H * D,) + tail)
+            k = w[:, 1].reshape((H * D,) + tail)
+            v = w[:, 2].reshape((H * D,) + tail)
+        return q, k, v
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) \
+            else ""
+        _set(params, "model/embed_tokens/embedding",
+             sd[f"{pfx}word_embeddings.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}ln_f.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}ln_f.bias"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) \
+            else ""
+        p = f"{pfx}h.{i}."
+        hc = cls._hc  # stashed by convert_state_dict (layout depends on it)
+        leaves = {}
+        q, k, v = cls._split_falcon_qkv(
+            sd[f"{p}self_attention.query_key_value.weight"], hc, cfg)
+        leaves["attn/q_proj/kernel"] = q.T
+        leaves["attn/k_proj/kernel"] = k.T
+        leaves["attn/v_proj/kernel"] = v.T
+        leaves["attn/o_proj/kernel"] = sd[f"{p}self_attention.dense.weight"].T
+        leaves["mlp/fc_in/kernel"] = sd[f"{p}mlp.dense_h_to_4h.weight"].T
+        leaves["mlp/fc_out/kernel"] = sd[f"{p}mlp.dense_4h_to_h.weight"].T
+        if cfg.attention_bias:  # bias=True variants: split the fused bias too
+            qb, kb, vb = cls._split_falcon_qkv(
+                sd[f"{p}self_attention.query_key_value.bias"], hc, cfg)
+            leaves["attn/q_proj/bias"] = qb
+            leaves["attn/k_proj/bias"] = kb
+            leaves["attn/v_proj/bias"] = vb
+            leaves["attn/o_proj/bias"] = sd[f"{p}self_attention.dense.bias"]
+            leaves["mlp/fc_in/bias"] = sd[f"{p}mlp.dense_h_to_4h.bias"]
+            leaves["mlp/fc_out/bias"] = sd[f"{p}mlp.dense_4h_to_h.bias"]
+        ln = "ln_attn" if f"{p}ln_attn.weight" in sd else "input_layernorm"
+        leaves["ln_attn/scale"] = sd[f"{p}{ln}.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}{ln}.bias"]
+        if not cfg.shared_parallel_ln:  # new arch: second LN feeds the MLP
+            leaves["ln_mlp/scale"] = sd[f"{p}ln_mlp.weight"]
+            leaves["ln_mlp/bias"] = sd[f"{p}ln_mlp.bias"]
+        return leaves
+
+    @classmethod
+    def convert_state_dict(cls, hf_config, sd, scan_layers: bool = True):
+        cls._hc = hf_config
+        try:
+            return super().convert_state_dict(hf_config, sd, scan_layers)
+        finally:
+            del cls._hc
+
+
 class HFQwen2LayerPolicy(HFLlamaLayerPolicy):
     """HF ``Qwen2ForCausalLM`` → the Llama graph with QKV biases (the only
     architectural delta; Qwen2's sliding window binds only when
@@ -899,6 +1036,7 @@ class MegatronLayerPolicy(_GenericTransformerPolicy):
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFQwen2LayerPolicy,
                                 HFLlamaLayerPolicy, HFMixtralLayerPolicy,
+                                HFFalconLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
                                 HFGPTJLayerPolicy, HFGPTNeoLayerPolicy]

@@ -456,3 +456,49 @@ def test_qwen2_logits_and_generate_parity():
         got = np.asarray(engine.generate(ids, max_new_tokens=6,
                                          do_sample=False))
         np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("variant", ["7b_mqa", "classic_mha_bias",
+                                     "new_arch", "falcon2_one_ln"])
+def test_falcon_logits_and_generate_parity(variant):
+    """Falcon: rotary + parallel attn/MLP across the architecture variants —
+    7b (one shared LN + multi-query), classic MHA with biases (per-head
+    interleaved fused QKV), 40b new_decoder_architecture (grouped KV + two
+    LNs), and falcon2-11B (new arch with num_ln_in_parallel_attn=1)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import match_policy
+
+    torch.manual_seed(0)
+    kwargs = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=4, bias=False, parallel_attn=True,
+                  alibi=False, max_position_embeddings=64,
+                  attention_dropout=0.0, hidden_dropout=0.0)
+    if variant == "new_arch":
+        kwargs.update(new_decoder_architecture=True, num_kv_heads=2)
+    elif variant == "falcon2_one_ln":
+        kwargs.update(new_decoder_architecture=True, num_kv_heads=2,
+                      num_ln_in_parallel_attn=1)
+    elif variant == "classic_mha_bias":
+        kwargs.update(new_decoder_architecture=False, multi_query=False,
+                      bias=True)
+    else:
+        kwargs.update(new_decoder_architecture=False, multi_query=True)
+    cfg = transformers.FalconConfig(**kwargs)
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    assert type(match_policy(hf)).__name__ == "HFFalconLayerPolicy"
+    engine = ds.init_inference(hf, dtype="fp32")
+
+    ids = np.random.RandomState(13).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref_logits = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(engine.module.apply({"params": engine.params},
+                                          jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref_logits, rtol=2e-3, atol=2e-3)
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()[:, 10:]
+    got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(got, ref)
