@@ -799,6 +799,72 @@ class HFFalconLayerPolicy(_GenericTransformerPolicy):
             del cls._hc
 
 
+class HFPhiLayerPolicy(_GenericTransformerPolicy):
+    """HF ``PhiForCausalLM`` (phi-1/1.5/2) → generic decoder: partial
+    rotary, parallel attention+MLP behind one shared layernorm, biases on
+    every projection, biased untied lm_head."""
+
+    hf_model_types = ("PhiForCausalLM", "phi", "PhiModel")
+
+    @classmethod
+    def convert_config(cls, hc, scan_layers):
+        from ..models.transformer import TransformerConfig
+
+        if getattr(hc, "qk_layernorm", False):
+            raise NotImplementedError(
+                "Phi qk_layernorm=True (per-head Q/K layernorms) is not "
+                "mapped; conversion would silently drop those weights")
+        if getattr(hc, "tie_word_embeddings", False):
+            raise NotImplementedError(
+                "tied-embedding Phi is not mapped: HF's lm_head keeps its "
+                "bias even when tied, and the tied logits path here has no "
+                "bias slot (no released Phi checkpoint ties embeddings)")
+        return TransformerConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            num_key_value_heads=getattr(hc, "num_key_value_heads", None),
+            max_position_embeddings=hc.max_position_embeddings,
+            pos_embedding="rope",
+            rotary_pct=getattr(hc, "partial_rotary_factor", 0.5),
+            rope_theta=getattr(hc, "rope_theta", 10000.0),
+            parallel_residual=True, shared_parallel_ln=True,
+            activation={"gelu": "gelu", "gelu_new": "gelu_new",
+                        "relu": "relu"}[hc.hidden_act],
+            norm_eps=hc.layer_norm_eps, pre_layernorm=True,
+            attention_bias=True, mlp_bias=True, lm_head_bias=True,
+            tie_word_embeddings=False, scan_layers=scan_layers)
+
+    @classmethod
+    def top_leaves(cls, params, sd, cfg):
+        pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+        _set(params, "model/embed_tokens/embedding",
+             sd[f"{pfx}embed_tokens.weight"])
+        _set(params, "model/final_ln/scale", sd[f"{pfx}final_layernorm.weight"])
+        _set(params, "model/final_ln/bias", sd[f"{pfx}final_layernorm.bias"])
+        if not cfg.tie_word_embeddings:
+            _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
+            if cfg.lm_head_bias:
+                _set(params, "lm_head/bias", sd["lm_head.bias"])
+
+    @classmethod
+    def layer_leaves(cls, sd, i, cfg):
+        pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+        p = f"{pfx}layers.{i}."
+        leaves = {}
+        for hf, fx in [("self_attn.q_proj", "attn/q_proj"),
+                       ("self_attn.k_proj", "attn/k_proj"),
+                       ("self_attn.v_proj", "attn/v_proj"),
+                       ("self_attn.dense", "attn/o_proj"),
+                       ("mlp.fc1", "mlp/fc_in"), ("mlp.fc2", "mlp/fc_out")]:
+            leaves[f"{fx}/kernel"] = sd[f"{p}{hf}.weight"].T
+            leaves[f"{fx}/bias"] = sd[f"{p}{hf}.bias"]
+        leaves["ln_attn/scale"] = sd[f"{p}input_layernorm.weight"]
+        leaves["ln_attn/bias"] = sd[f"{p}input_layernorm.bias"]
+        return leaves
+
+
 class HFQwen2LayerPolicy(HFLlamaLayerPolicy):
     """HF ``Qwen2ForCausalLM`` → the Llama graph with QKV biases (the only
     architectural delta; Qwen2's sliding window binds only when
@@ -1036,7 +1102,7 @@ class MegatronLayerPolicy(_GenericTransformerPolicy):
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFQwen2LayerPolicy,
                                 HFLlamaLayerPolicy, HFMixtralLayerPolicy,
-                                HFFalconLayerPolicy,
+                                HFFalconLayerPolicy, HFPhiLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,
                                 HFGPTJLayerPolicy, HFGPTNeoLayerPolicy]

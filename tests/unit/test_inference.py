@@ -502,3 +502,52 @@ def test_falcon_logits_and_generate_parity(variant):
                           do_sample=False, pad_token_id=0).numpy()[:, 10:]
     got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_phi_logits_and_generate_parity():
+    """Phi (phi-1/1.5/2 architecture): partial rotary, parallel attn+MLP
+    behind one shared LN, biases everywhere, biased untied lm_head."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import match_policy
+
+    torch.manual_seed(0)
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        attention_dropout=0.0, resid_pdrop=0.0, embd_pdrop=0.0)
+    hf = transformers.PhiForCausalLM(cfg).eval()
+    assert type(match_policy(hf)).__name__ == "HFPhiLayerPolicy"
+    engine = ds.init_inference(hf, dtype="fp32")
+
+    ids = np.random.RandomState(17).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref_logits = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(engine.module.apply({"params": engine.params},
+                                          jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref_logits, rtol=2e-3, atol=2e-3)
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()[:, 10:]
+    got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_phi_unmappable_variants_refused():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+
+    base = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64)
+    torch.manual_seed(0)
+    with pytest.raises(NotImplementedError, match="qk_layernorm"):
+        replace_transformer_layer(transformers.PhiForCausalLM(
+            transformers.PhiConfig(**base, qk_layernorm=True)).eval())
+    with pytest.raises(NotImplementedError, match="tied-embedding"):
+        replace_transformer_layer(transformers.PhiForCausalLM(
+            transformers.PhiConfig(**base, tie_word_embeddings=True)).eval())
