@@ -44,6 +44,11 @@ class LlamaConfig:
     sliding_window: Optional[int] = None
     #: Qwen2-style: biases on q/k/v projections (o/mlp stay bias-free)
     attention_qkv_bias: bool = False
+    #: Gemma-style knobs: explicit head_dim (H*D need not equal hidden),
+    #: gelu-tanh MLP activation, sqrt(hidden) embedding scaling
+    head_dim_override: Optional[int] = None
+    mlp_activation: str = "silu"  # "silu" | "gelu_tanh"
+    embed_scale: Optional[float] = None
     attention_impl: str = "xla"  # "xla" | "flash"
     #: cached single-token attention: "xla" (repeat_kv + full-cache softmax)
     #: or "pallas" (ops/pallas/decode_attention.py — the softmax_context
@@ -68,6 +73,8 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
     @staticmethod
@@ -157,7 +164,9 @@ class LlamaMLP(nn.Module):
                                              param_dtype=jnp.float32)
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
-        return dense(cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+        act = nn.silu if cfg.mlp_activation == "silu" else \
+            (lambda g: nn.gelu(g, approximate=True))  # gemma gelu_pytorch_tanh
+        return dense(cfg.hidden_size, "down_proj")(act(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -213,6 +222,9 @@ class LlamaModel(nn.Module):
         B, T = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
                      param_dtype=jnp.float32)(input_ids)
+        if cfg.embed_scale is not None:
+            # gemma: hidden states scaled by sqrt(hidden) in the embed dtype
+            x = x * jnp.asarray(cfg.embed_scale, x.dtype)
         if positions is None:
             start = 0 if cache_index is None else cache_index
             positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))

@@ -165,10 +165,11 @@ class HFLlamaLayerPolicy(DSPolicy):
         return self.convert_state_dict(hf_model.config, sd, scan_layers)
 
     @classmethod
-    def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
-        from ..models.llama import LlamaConfig, LlamaForCausalLM
+    def _build_config(cls, hc, scan_layers):
+        """Target LlamaConfig; Gemma overrides (head_dim, activation, ...)."""
+        from ..models.llama import LlamaConfig
 
-        cfg = LlamaConfig(
+        return LlamaConfig(
             sliding_window=cls._window(hc),
             vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
             intermediate_size=hc.intermediate_size,
@@ -182,11 +183,23 @@ class HFLlamaLayerPolicy(DSPolicy):
             tie_word_embeddings=getattr(hc, "tie_word_embeddings", False),
             attention_qkv_bias=cls.QKV_BIAS,
             scan_layers=scan_layers, remat=False)
+
+    @staticmethod
+    def _leaf_transform(suffix, w):
+        """Per-leaf value hook (Gemma folds the zero-centered +1 here)."""
+        return w
+
+    @classmethod
+    def convert_state_dict(cls, hc, sd, scan_layers: bool = True):
+        from ..models.llama import LlamaForCausalLM
+
+        cfg = cls._build_config(hc, scan_layers)
         pfx = "model." if any(k.startswith("model.") for k in sd) else ""
 
         params: Dict[str, Any] = {}
         _set(params, "model/embed_tokens/embedding", sd[f"{pfx}embed_tokens.weight"])
-        _set(params, "model/norm/scale", sd[f"{pfx}norm.weight"])
+        _set(params, "model/norm/scale",
+             cls._leaf_transform("norm.weight", sd[f"{pfx}norm.weight"]))
         if not cfg.tie_word_embeddings:
             _set(params, "lm_head/kernel", sd["lm_head.weight"].T)
 
@@ -196,7 +209,7 @@ class HFLlamaLayerPolicy(DSPolicy):
                           for p in ("q_proj", "k_proj", "v_proj")]
 
         def layer_leaf(i, suffix, transpose):
-            w = sd[f"{pfx}layers.{i}.{suffix}"]
+            w = cls._leaf_transform(suffix, sd[f"{pfx}layers.{i}.{suffix}"])
             return w.T if transpose else w
 
         if scan_layers:
@@ -799,6 +812,52 @@ class HFFalconLayerPolicy(_GenericTransformerPolicy):
             del cls._hc
 
 
+class HFGemmaLayerPolicy(HFLlamaLayerPolicy):
+    """HF ``GemmaForCausalLM`` → the Llama graph with Gemma's deltas:
+    explicit head_dim (H*D != hidden), gelu-tanh MLP, sqrt(hidden) embedding
+    scaling, tied embeddings, and zero-centered RMSNorm weights — HF
+    computes ``x * (1 + w)``, so ``1 + w`` is folded into our scale at
+    conversion (identical math, no model change)."""
+
+    hf_model_types = ("GemmaForCausalLM", "gemma", "GemmaModel")
+
+    @classmethod
+    def _build_config(cls, hc, scan_layers):
+        from ..models.llama import LlamaConfig
+
+        explicit = getattr(hc, "hidden_activation", None)
+        if explicit is None or explicit == "gelu_pytorch_tanh":
+            # legacy configs (hidden_activation unset): HF itself falls back
+            # to the tanh approximation regardless of hidden_act
+            mlp_act = "gelu_tanh"
+        elif explicit == "gelu":
+            mlp_act = "gelu"  # exact erf GELU, explicitly requested
+        else:
+            raise NotImplementedError(
+                f"gemma activation {explicit!r} not mapped")
+        return LlamaConfig(
+            vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            num_key_value_heads=hc.num_key_value_heads,
+            max_position_embeddings=hc.max_position_embeddings,
+            rms_norm_eps=hc.rms_norm_eps,
+            rope_theta=getattr(hc, "rope_theta", 10000.0),
+            tie_word_embeddings=True,  # gemma always ties
+            head_dim_override=hc.head_dim, mlp_activation=mlp_act,
+            embed_scale=float(hc.hidden_size) ** 0.5,
+            scan_layers=scan_layers, remat=False)
+
+    @staticmethod
+    def _leaf_transform(suffix, w):
+        # HF Gemma RMSNorm computes x * (1 + w): fold the offset into the
+        # plain-scale convention here
+        if suffix.endswith("norm.weight"):
+            return 1.0 + w
+        return w
+
+
 class HFPhiLayerPolicy(_GenericTransformerPolicy):
     """HF ``PhiForCausalLM`` (phi-1/1.5/2) → generic decoder: partial
     rotary, parallel attention+MLP behind one shared layernorm, biases on
@@ -1101,7 +1160,8 @@ class MegatronLayerPolicy(_GenericTransformerPolicy):
 
 #: All registered policies (reference: ``replace_policies`` list)
 generic_policies: List[type] = [HFGPT2LayerPolicy, HFQwen2LayerPolicy,
-                                HFLlamaLayerPolicy, HFMixtralLayerPolicy,
+                                HFGemmaLayerPolicy, HFLlamaLayerPolicy,
+                                HFMixtralLayerPolicy,
                                 HFFalconLayerPolicy, HFPhiLayerPolicy,
                                 HFOPTLayerPolicy, HFBloomLayerPolicy,
                                 HFGPTNeoXLayerPolicy, HFBertLayerPolicy,

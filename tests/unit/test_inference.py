@@ -551,3 +551,35 @@ def test_phi_unmappable_variants_refused():
     with pytest.raises(NotImplementedError, match="tied-embedding"):
         replace_transformer_layer(transformers.PhiForCausalLM(
             transformers.PhiConfig(**base, tie_word_embeddings=True)).eval())
+
+
+def test_gemma_logits_and_generate_parity():
+    """Gemma: explicit head_dim, gelu-tanh GeGLU, sqrt(hidden) embedding
+    scale, tied embeddings, zero-centered RMSNorm folded at conversion."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import match_policy
+
+    torch.manual_seed(0)
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, attention_dropout=0.0)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    assert type(match_policy(hf)).__name__ == "HFGemmaLayerPolicy"
+    engine = ds.init_inference(hf, dtype="fp32")
+    assert engine.module.config.head_dim == 16
+
+    ids = np.random.RandomState(19).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref_logits = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(engine.module.apply({"params": engine.params},
+                                          jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref_logits, rtol=2e-3, atol=2e-3)
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()[:, 10:]
+    got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(got, ref)
