@@ -173,6 +173,7 @@ def main():
 
     # 2) candidates, best-first, each in a capped subprocess
     candidates = [
+        ("dots-remat,B32", "dots", 32),  # biggest MXU fill that may fit HBM
         ("dots-remat,B16", "dots", 16),
         ("dots-remat,B8", "dots", 8),
         ("full-remat,B8", "nothing", 8),  # r1 baseline configuration
