@@ -1,0 +1,46 @@
+"""Runtime utilities (reference ``deepspeed/runtime/utils.py``: the pieces
+with behavior on TPU — memory reporting; clipping/overflow live inside the
+compiled step, partition helpers inside the sharding policies)."""
+
+import gc
+import os
+
+import jax
+
+from ..utils.logging import logger
+
+
+def _host_rss_gb() -> float:
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1e6  # kB → GB
+    except OSError:
+        pass
+    return 0.0
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Reference ``see_memory_usage`` (``runtime/utils.py:817``): log device
+    + host memory at a checkpointed moment. Device side = live jax array
+    bytes plus the backend's allocator stats when it exposes them
+    (``device.memory_stats()`` on TPU)."""
+    if not force:
+        return
+    if jax.process_index() != 0:
+        return
+    gc.collect()
+    live = sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    parts = [f"live device arrays {live / 1e9:.2f} GB",
+             f"host RSS {_host_rss_gb():.2f} GB"]
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            parts.append(f"bytes_in_use {stats['bytes_in_use'] / 1e9:.2f} GB")
+        if "peak_bytes_in_use" in stats:
+            parts.append(
+                f"peak_bytes_in_use {stats['peak_bytes_in_use'] / 1e9:.2f} GB")
+    except Exception:  # backend without allocator stats (CPU)
+        pass
+    logger.info(f"MEM {message} | " + ", ".join(parts))

@@ -1,0 +1,57 @@
+"""Meta-device model construction (reference ``deepspeed/utils/
+init_on_device.py:10`` ``OnDevice``: build a torch model whose params live
+on the meta device — shapes without storage — so a 100B config can be
+declared before sharded materialization).
+
+JAX separates module *definitions* from *weights*, so the analog is
+abstract initialization: ``jax.eval_shape`` of the init function yields the
+full parameter pytree as ``ShapeDtypeStruct``s with ZERO materialization —
+exactly what the engine itself does to derive shardings before the
+born-sharded init (``runtime/engine.py _make_init_fn``).
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """Parity shim for the reference context manager.
+
+    ``with OnDevice(dtype=jnp.bfloat16) as ctx:`` →
+    ``ctx.abstract_init(module, rngs, x)`` builds shape-only params.
+    Materialization happens later via ``jax.jit(init, out_shardings=...)``
+    — params are born sharded, the role of ``deepspeed.zero.Init`` after an
+    OnDevice construction. (The context-manager form exists for reference
+    API parity; it carries the dtype/device settings, nothing global.)
+    """
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def abstract_init(self, module, rngs, *args, **kwargs) -> Any:
+        """Shape-only params for ``module.init(rngs, *args)`` — no FLOPs, no
+        memory; optionally re-typed to ``self.dtype``."""
+        shapes = jax.eval_shape(lambda r, *a: module.init(r, *a, **kwargs),
+                                rngs, *args)
+        if self.dtype is None:
+            return shapes
+        return jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, self.dtype
+                if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+            shapes)
+
+
+def on_device_abstract_init(module, rngs, *args, dtype=None, **kwargs):
+    """Functional one-shot form."""
+    return OnDevice(dtype=dtype).abstract_init(module, rngs, *args, **kwargs)

@@ -117,3 +117,58 @@ def test_ds_bench_comm_sweep():
     assert {r["op"] for r in recs} == {"all_reduce", "all_gather",
                                        "reduce_scatter", "all_to_all", "p2p"}
     assert all(r["algbw_gbps"] > 0 and r["world"] == 8 for r in recs)
+
+
+def test_ds_ssh_fanout_and_exit_codes(tmp_path):
+    import subprocess
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("hostA slots=1\nhostB slots=1\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # fake ssh on PATH: echoes per-host, fails on hostB
+    fake = tmp_path / "ssh"
+    fake.write_text("#!/bin/bash\n"
+                    "host=$3\n"  # ssh -o StrictHostKeyChecking=no <host> cmd
+                    "echo \"ran-on $host\"\n"
+                    "[ \"$host\" = hostB ] && exit 3 || exit 0\n")
+    fake.chmod(0o755)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bin", "ds_ssh"),
+                        "-f", str(hf), "true"], env=env, capture_output=True,
+                       text=True)
+    # per-host prefixed fan-out output and the WORST exit code propagate
+    assert "[hostA] ran-on hostA" in r.stdout
+    assert "[hostB] ran-on hostB" in r.stdout
+    assert r.returncode == 3
+
+    # no command -> argparse error, rc 2
+    r2 = subprocess.run([sys.executable, os.path.join(REPO, "bin", "ds_ssh"),
+                         "-f", str(hf)], env=env, capture_output=True,
+                        text=True)
+    assert r2.returncode == 2 and "no command" in r2.stderr
+
+
+def test_utils_parity_helpers():
+    """see_memory_usage + OnDevice abstract init (reference
+    runtime/utils.py:817, utils/init_on_device.py:10)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.utils import see_memory_usage
+    from deepspeed_tpu.utils.init_on_device import OnDevice
+
+    see_memory_usage("test checkpoint", force=True)  # must not raise
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(x)
+
+    with OnDevice(dtype=jnp.bfloat16) as ctx:
+        shapes = ctx.abstract_init(M(), jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 4)))
+    leaves = jax.tree_util.tree_leaves(shapes)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    assert all(l.dtype == jnp.bfloat16 for l in leaves)
