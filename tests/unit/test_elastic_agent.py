@@ -105,3 +105,68 @@ def test_kill_worker_respawns_and_resumes(tmp_path):
     assert rec["start_step"] == 5      # resumed from the step-5 auto-save
     assert rec["restart"] >= 1         # second incarnation finished the run
     assert "incarnation 1" in out.stderr
+
+
+@pytest.mark.slow
+def test_persistent_failure_shrinks_world_and_completes(tmp_path):
+    """Two consecutive failures at world=2 shrink to the next compatible
+    count (1); the universal checkpoint restores ACROSS the topology change
+    and the run completes — the reference DSElasticAgent's resize+resume
+    loop end to end."""
+    script = tmp_path / "train_shrink.py"
+    # rank 1 kills itself at step 3 in EVERY incarnation, so world=2 can
+    # never finish; the step-2 auto-save must carry over to the 1-proc mesh
+    script.write_text(textwrap.dedent("""\
+        import json, os, signal
+        import numpy as np
+        import jax
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(remat=False)
+        model = LlamaForCausalLM(cfg)
+        rs = np.random.RandomState(0)
+        batch = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)),
+                 "labels": rs.randint(0, cfg.vocab_size, (8, 16))}
+        engine, *_ = ds.initialize(model=model,
+            config={"train_batch_size": 8,
+                    "elasticity": {"enabled": True,
+                                   "micro_batch_sizes": [1, 2, 4],
+                                   "max_train_batch_size": 8,
+                                   "min_gpus": 1, "max_gpus": 8,
+                                   "ignore_non_elastic_batch_info": True,
+                                   "save_interval": 2},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "steps_per_print": 0},
+            example_batch={k: v[:1] for k, v in batch.items()})
+        start = engine.global_steps
+        while engine.global_steps < 6:
+            loss = engine.train_batch(batch=batch)
+            if jax.process_count() == 2 and engine.global_steps == 3 \\
+                    and jax.process_index() == 1:
+                os.kill(os.getpid(), signal.SIGKILL)
+        if jax.process_index() == 0:
+            with open(os.environ["DS_DONE_FILE"], "w") as f:
+                json.dump({"step": engine.global_steps,
+                           "start_step": start,
+                           "world": jax.process_count(),
+                           "loss": float(loss)}, f)
+        print("DONE", flush=True)
+        """))
+    done = tmp_path / "done.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DS_DONE_FILE"] = str(done)
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--elastic", "--num_procs", "2", "--cpu_devices_per_proc", "4",
+         "--max_elastic_restarts", "4",
+         "--elastic_checkpoint_dir", str(tmp_path / "eckpt"),
+         "--coordinator_port", "29761", str(script)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(done.read_text())
+    assert rec["world"] == 1          # completed at the SHRUNK world size
+    assert rec["step"] == 6
+    assert rec["start_step"] >= 2     # resumed from an auto-save, not scratch
+    assert "at 1 workers" in out.stderr
