@@ -31,7 +31,10 @@ over the batch axes, so the compressed arrays are literally what crosses
 the interconnect.
 
 Restrictions (reference has the same shape): pure data parallelism —
-ZeRO stage 0, no model/seq axes, gas=1, bf16/fp32 (no loss scaling).
+ZeRO stage 0, no model/seq axes, bf16/fp32 (no loss scaling). Gradient
+accumulation composes (r3): local grads accumulate over microbatches with
+no collectives in the scan, then ONE compressed exchange per optimizer
+step.
 """
 
 from typing import Any, NamedTuple
@@ -80,8 +83,6 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
         raise ValueError("compressed 1-bit training requires ZeRO stage 0 "
                          "(params replicated; the compressed quantity is the "
                          "full momentum)")
-    if engine.gradient_accumulation_steps != 1:
-        raise ValueError("compressed 1-bit training supports gas=1")
     if engine.fp16_enabled:
         raise ValueError("use bf16/fp32 with compressed 1-bit training")
 
@@ -119,15 +120,19 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
                                     var_counter=repl)
 
     axis_tuple = axes if len(axes) > 1 else axes[0]
-    from .step_common import make_local_loss
+    from .step_common import accumulate_local_grads, make_local_loss
 
     local_loss = make_local_loss(engine)
+    gas = engine.gradient_accumulation_steps
 
     def spmd(params, mu, nu, werr, serr, vint, vcnt, count, batch, rng):
         # per-rank: lose the leading sharded axis of the error buffers
         werr, serr = werr[0], serr[0]
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_tuple))
-        loss_local, g = jax.value_and_grad(local_loss)(params, batch, rng)
+        # gas > 1: LOCAL grads accumulate over microbatches (no collectives
+        # inside the scan), then ONE compressed exchange per optimizer step
+        loss_local, g = accumulate_local_grads(local_loss, params, batch,
+                                               rng, gas)
         loss = jax.lax.pmean(loss_local, axis_tuple)
         flat_g = jnp.pad(ravel_pytree(g)[0], (0, n_pad - n))
         # monitoring: norm of the MEAN gradient (exact in warmup; in the
@@ -220,16 +225,15 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
     def train_step(state, batch, rng):
         count = state.step + 1
         mu, nu, werr, serr, vint, vcnt = state.opt_state
-        squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
         fn = jax.shard_map(
             spmd, mesh=mesh, axis_names=frozenset(axes),
             in_specs=(P(), P(), P(), P(axes), P(axes), P(), P(), P(),
-                      P(axis_tuple), P()),
+                      P(None, axes), P()),
             out_specs=(P(), P(), P(), P(axes), P(axes), P(), P(), P(), P()),
             check_vma=False)
         (new_params, mu2, nu2, werr2, serr2, vint2, vcnt2, loss,
          grad_norm) = fn(state.params, mu, nu, werr, serr, vint, vcnt, count,
-                         squeezed, rng)
+                         batch, rng)
         new_state = state.replace(
             step=count, params=new_params,
             opt_state=OneBitWireState(mu2, nu2, werr2, serr2, vint2, vcnt2))

@@ -43,8 +43,6 @@ COMPRESS = {"compression_training": {"sparse_pruning": {
     ({**OPT, **OFFLOAD, "sparse_gradients": True}, "does not compose"),
     # 1-bit wire exclusions
     ({**WIRE, "zero_optimization": {"stage": 2}}, "ZeRO stage 0"),
-    ({**WIRE, "train_batch_size": 16, "gradient_accumulation_steps": 2,
-      "train_micro_batch_size_per_gpu": 1}, "gas=1"),
     ({**WIRE, "fp16": {"enabled": True}}, "bf16/fp32"),
     ({**WIRE, **MOQ}, "does not compose"),
     ({**WIRE, **PLD}, "does not compose|pld"),

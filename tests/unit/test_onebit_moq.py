@@ -246,3 +246,28 @@ def test_eigenvalue_on_model_loss_is_finite():
     loss = lambda p: model.apply({"params": p}, ids, labels=ids)
     eig = Eigenvalue(max_iter=8, tol=1e-1).compute(loss, params)
     assert np.isfinite(eig) and eig > 0
+
+
+def test_onebit_wire_with_gradient_accumulation():
+    """gas > 1 composes with the wire path (r3: local grads accumulate over
+    microbatches, ONE compressed exchange per optimizer step)."""
+    from deepspeed_tpu.comm.comm import comms_logger
+
+    comms_logger.comms_dict.clear()
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(0, cfg.vocab_size, (32, 16)),
+             "labels": rs.randint(0, cfg.vocab_size, (32, 16))}
+    engine, *_ = ds.initialize(
+        model=model,
+        config={"train_batch_size": 32, "gradient_accumulation_steps": 2,
+                "comms_logger": {"enabled": True},
+                "optimizer": {"type": "OnebitAdam",
+                              "params": {"lr": 3e-3, "freeze_step": 2,
+                                         "comm_backend_name": "compressed"}},
+                "steps_per_print": 0},
+        example_batch={k: v[:1] for k, v in batch.items()})
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 1.0, losses
+    assert "compressed_allreduce" in comms_logger.comms_dict
