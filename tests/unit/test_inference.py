@@ -300,6 +300,43 @@ def _tiny_hf(family, seed=0):
             max_position_embeddings=64, type_vocab_size=2,
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
         return transformers.BertForMaskedLM(cfg).eval()
+    if family == "qwen2":
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_dropout=0.0)
+        return transformers.Qwen2ForCausalLM(cfg).eval()
+    if family == "gemma":
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=1, head_dim=16, max_position_embeddings=64,
+            attention_dropout=0.0)
+        return transformers.GemmaForCausalLM(cfg).eval()
+    if family == "falcon":
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, bias=False, parallel_attn=True,
+            alibi=False, new_decoder_architecture=False, multi_query=True,
+            max_position_embeddings=64, attention_dropout=0.0,
+            hidden_dropout=0.0)
+        return transformers.FalconForCausalLM(cfg).eval()
+    if family == "phi":
+        cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5,
+            attention_dropout=0.0, resid_pdrop=0.0, embd_pdrop=0.0)
+        return transformers.PhiForCausalLM(cfg).eval()
+    if family == "mixtral":
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            num_local_experts=4, num_experts_per_tok=2,
+            attention_dropout=0.0)
+        return transformers.MixtralForCausalLM(cfg).eval()
     raise ValueError(family)
 
 

@@ -23,6 +23,11 @@ def _convert(family):
     ("gpt2", 1),              # the BASELINE bring-up slice
     ("opt", 2),
     ("gptj", 0),
+    ("qwen2", 2),
+    ("gemma", 1),
+    pytest.param("falcon", 2, marks=pytest.mark.slow),
+    pytest.param("phi", 1, marks=pytest.mark.slow),
+    pytest.param("mixtral", 0, marks=pytest.mark.slow),
     pytest.param("bloom", 2, marks=pytest.mark.slow),
     pytest.param("gpt_neox", 3, marks=pytest.mark.slow),
 ])
