@@ -62,6 +62,10 @@ class TransformerConfig:
     lm_head_bias: bool = False   # GPT-J's lm_head carries a bias
     mlm_head: bool = False       # BERT cls.predictions transform+decoder
     attention_impl: str = "xla"
+    #: cached single-token attention: "xla" or "pallas"
+    #: (ops/pallas/decode_attention.py); the kernel path engages only for
+    #: configs it can represent (no alibi, no per-layer local kinds)
+    decode_attention_impl: str = "xla"
     # GPT-Neo: per-layer attention kind, e.g. ("global","local",...) cycled
     # over layers; "local" limits causal attention to a sliding window
     attention_layers: Optional[tuple] = None
@@ -77,6 +81,14 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_key_value_heads or self.num_attention_heads
+
+    def pallas_decode_eligible(self, q_len: int) -> bool:
+        """Static predicate shared by the model (bias construction) and the
+        attention (kernel dispatch): the decode kernel represents triangular
+        + key-padding masking only."""
+        return (self.decode_attention_impl == "pallas" and q_len == 1
+                and self.pos_embedding != "alibi"
+                and self.attention_layers is None)
 
     @property
     def rotary_dim(self) -> int:
@@ -157,10 +169,20 @@ class GenericAttention(nn.Module):
             k = _apply_rotary_partial(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
         if layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
-            k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
-            v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
-            out = dot_product_attention(q, k, v, bias=bias, causal=False,
-                                        scale=cfg.attention_scale)
+            if cfg.pallas_decode_eligible(T):
+                # bias carries the RAW [B, S] key mask on this path (the
+                # model skipped the dense bias; see TransformerModel)
+                from ..ops.pallas.decode_attention import decode_attention
+
+                out = decode_attention(q[:, 0], layer_cache["k"],
+                                       layer_cache["v"], cache_index,
+                                       key_mask=bias,
+                                       sm_scale=cfg.attention_scale)[:, None]
+            else:
+                k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
+                v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+                out = dot_product_attention(q, k, v, bias=bias, causal=False,
+                                            scale=cfg.attention_scale)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)
@@ -279,10 +301,17 @@ class TransformerModel(nn.Module):
             jax.tree_util.tree_leaves(cache)[0].shape[-3]
         bias = None
         if cache is not None:
-            key_mask = attention_mask  # [B, S] over the cache
-            bias = cache_attention_bias(T, kv_len, cache_index, key_mask=key_mask)
             if not cfg.causal:
                 raise ValueError("KV cache requires a causal decoder config")
+            key_mask = attention_mask  # [B, S] over the cache
+            if cfg.pallas_decode_eligible(T):
+                # kernel path: the attention consumes the RAW key mask (the
+                # kernel folds triangular masking itself; None = no padding,
+                # the kernel's own default)
+                bias = key_mask
+            else:
+                bias = cache_attention_bias(T, kv_len, cache_index,
+                                            key_mask=key_mask)
         elif attention_mask is not None:
             bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
                              -1e9).astype(jnp.float32)

@@ -70,3 +70,52 @@ def test_cpu_fallback_matches_and_model_wiring():
         eng = ds.init_inference(model, params=params, max_out_tokens=20)
         outs[impl] = np.asarray(eng.generate(ids, max_new_tokens=6))
     np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+
+
+@pytest.mark.parametrize("family", ["opt", "gpt_neox", "phi"])
+def test_generic_transformer_pallas_decode_wiring(family):
+    """decode_attention_impl='pallas' on the generic transformer generates
+    identical tokens to the xla decode path for eligible families (no
+    alibi/local kinds)."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+    from tests.unit.test_inference import _tiny_hf
+
+    hf = _tiny_hf(family)
+    model, params = replace_transformer_layer(hf)
+    ids = np.random.RandomState(23).randint(0, 128, (2, 10))
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = dataclasses.replace(model.config, decode_attention_impl=impl)
+        m = type(model)(cfg)
+        eng = ds.init_inference(m, params=params, dtype="fp32",
+                                max_out_tokens=24)
+        outs[impl] = np.asarray(eng.generate(ids, max_new_tokens=6,
+                                             do_sample=False))
+    np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+
+
+def test_generic_transformer_pallas_decode_ineligible_alibi():
+    """BLOOM (alibi) must stay on the xla path even when pallas is asked
+    for — eligibility is static and the output must still be correct."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+    from tests.unit.test_inference import _tiny_hf
+
+    hf = _tiny_hf("bloom")
+    model, params = replace_transformer_layer(hf)
+    assert not dataclasses.replace(
+        model.config, decode_attention_impl="pallas").pallas_decode_eligible(1)
+    ids = np.random.RandomState(29).randint(0, 128, (2, 8))
+    cfg = dataclasses.replace(model.config, decode_attention_impl="pallas")
+    eng = ds.init_inference(type(model)(cfg), params=params, dtype="fp32",
+                            max_out_tokens=20)
+    base = ds.init_inference(model, params=params, dtype="fp32",
+                             max_out_tokens=20)
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(ids, max_new_tokens=5, do_sample=False)),
+        np.asarray(base.generate(ids, max_new_tokens=5, do_sample=False)))
