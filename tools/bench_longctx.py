@@ -6,6 +6,10 @@ sequence-parallel attention in `sequence/` — the Ulysses/ring variants need
 a seq mesh axis and are exercised by `tests/unit/test_sequence.py` and the
 driver dryrun rather than this single-chip script).
 
+Hardened like bench.py: on the real chip the backend is probed with a
+short subprocess deadline first, and a JSON line is ALWAYS emitted — the
+sweep records when the backend is down instead of hanging the caller.
+
 Usage: python tools/bench_longctx.py [--cpu] [--seqs 4096,8192,16384]
 """
 
@@ -32,6 +36,24 @@ def main():
     ap.add_argument("--head_dim", type=int, default=128)
     ap.add_argument("--batch", type=int, default=1)
     args = ap.parse_args()
+
+    if not args.cpu:
+        import subprocess
+
+        probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
+        probe = ("import json, time\nt0 = time.time()\nimport jax\n"
+                 "d = jax.devices()\nprint(json.dumps({'n': len(d)}))\n")
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, text=True,
+                               timeout=probe_deadline)
+            ok = r.returncode == 0 and "{" in r.stdout
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            print(json.dumps({"metric": "longctx_attention",
+                              "error": "backend unavailable"}), flush=True)
+            return
 
     import jax
 
