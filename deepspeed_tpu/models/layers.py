@@ -119,6 +119,20 @@ def dot_product_attention(q, k, v, bias=None, causal: bool = False,
         raise NotImplementedError(
             f"sliding-window attention is not composed with "
             f"attention_impl={attention_impl!r} yet; use 'flash' or 'xla'")
+    if attention_impl == "ulysses_flash":
+        # DeepSpeed-Ulysses execution shape for LONG T: explicit all_to_all
+        # head<->token swap in shard_map, flash kernel per shard
+        if scale is not None or use_dropout or bias is not None:
+            raise NotImplementedError(
+                "attention_impl='ulysses_flash' supports causal masking only "
+                "(no bias/dropout/custom scale); drop padding via the loss "
+                "mask")
+        from ..sequence.ulysses import ulysses_flash_attention
+
+        return ulysses_flash_attention(q, k, v, causal=causal,
+                                       block_q=flash_block_q,
+                                       block_k=flash_block_k,
+                                       window=window)
     if attention_impl == "ulysses":
         if scale is not None:
             raise NotImplementedError(

@@ -1,2 +1,3 @@
 from .ring import ring_attention  # noqa: F401
-from .ulysses import DistributedAttention, ulysses_attention  # noqa: F401
+from .ulysses import (DistributedAttention, ulysses_attention,  # noqa: F401
+                      ulysses_flash_attention)

@@ -84,6 +84,71 @@ def ulysses_attention(q, k, v, causal: bool = False, bias=None,
     return out
 
 
+def ulysses_flash_attention(q, k, v, causal: bool = True, mesh=None,
+                            block_q: int = 512, block_k: int = 512,
+                            window=None):
+    """Ulysses with the FLASH kernel on each shard — the DeepSpeed-Ulysses
+    execution shape for LONG sequences.
+
+    The auto-sharding ``ulysses_attention`` leaves the attention core to the
+    partitioner, which cannot partition a Pallas call; this variant makes
+    the head<->token swap EXPLICIT inside a shard_map over ``seq``:
+    ``lax.all_to_all`` turns the token shard ``[B, T/sp, H, D]`` into a head
+    shard ``[B, T, H/sp, D]`` (two ICI all_to_alls, the wire pattern of
+    DeepSpeed-Ulysses), the flash kernel runs on that LOCAL full-sequence /
+    local-heads block (O(T * block) memory via online softmax), and the
+    inverse all_to_all restores token sharding. Backward differentiates
+    through (all_to_all transposes to itself on the reverse permutation).
+
+    Head count must divide the ``seq`` axis size into whole heads.
+    """
+    from ..ops.pallas.flash_attention import flash_attention
+
+    mesh = mesh or get_mesh()
+    sp = _axis_size(mesh, "seq")
+    if sp <= 1:
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, window=window)
+    if _axis_size(mesh, "model") > 1:
+        # a Pallas call cannot be partitioned over the auto model axis:
+        # TP-sharded heads would be gathered per shard (duplicated compute)
+        raise NotImplementedError(
+            "ulysses_flash does not compose with tensor parallelism "
+            "(model axis > 1): the per-shard flash kernel cannot be "
+            "partitioned over TP heads; use attention_impl='ulysses' "
+            "(XLA core) or ring attention")
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(f"ulysses_flash needs head count ({H}) divisible "
+                         f"by the seq axis ({sp}); use ring attention for "
+                         "head-count-independent scaling")
+    if q.shape[1] % sp:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by "
+                         f"seq axis size {sp}")
+
+    def local(ql, kl, vl):
+        # token shard -> head shard: split heads (axis 2), gather tokens
+        # (axis 1) across the seq group
+        swap = lambda x: jax.lax.all_to_all(x, "seq", split_axis=2,
+                                            concat_axis=1, tiled=True)
+        qh, kh, vh = swap(ql), swap(kl), swap(vl)
+        # post-swap each shard holds the FULL sequence (local heads), so the
+        # kernel's global sliding window applies unchanged
+        out = flash_attention(qh, kh, vh, causal=causal, block_q=block_q,
+                              block_k=block_k, window=window)
+        # head shard -> token shard
+        return jax.lax.all_to_all(out, "seq", split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(None, "seq")
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=frozenset({"seq"}),
+                       check_vma=False)
+    if not any(isinstance(x, jax.core.Tracer) for x in (q, k, v)):
+        return jax.jit(fn)(q, k, v)  # partial-manual needs a jit trace
+    return fn(q, k, v)
+
+
 class DistributedAttention:
     """Parity shim for DeepSpeed-Ulysses' ``DistributedAttention`` wrapper:
     wraps any attention core with the head↔seq swap."""

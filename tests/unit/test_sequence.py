@@ -68,6 +68,44 @@ def test_ulysses_attention_matches_plain(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_flash_matches_plain(causal):
+    """The shard_map Ulysses (explicit all_to_all swap + flash core per
+    shard) — fwd AND bwd parity vs plain attention."""
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ulysses_flash_attention
+
+    mesh = build_mesh(seq=4, data=2)
+    set_mesh(mesh)
+    q, k, v = _qkv()  # H=4 divisible by seq=4
+    out = jax.jit(lambda a, b, c: ulysses_flash_attention(
+        a, b, c, causal=causal, mesh=mesh, block_q=16, block_k=16))(q, k, v)
+    ref = _plain(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(ulysses_flash_attention(
+        a, b, c, causal=causal, mesh=mesh, block_q=16, block_k=16) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(_plain(a, b, c, causal) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_ulysses_flash_rejects_indivisible_heads():
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ulysses_flash_attention
+
+    mesh = build_mesh(seq=8)
+    set_mesh(mesh)
+    q, k, v = _qkv()  # H=4 < seq=8
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(lambda a, b, c: ulysses_flash_attention(
+            a, b, c, mesh=mesh))(q, k, v)
+
+
 def test_ring_attention_no_seq_axis_falls_back():
     from deepspeed_tpu.parallel import build_mesh, set_mesh
     from deepspeed_tpu.sequence import ring_attention
@@ -82,7 +120,7 @@ def test_ring_attention_no_seq_axis_falls_back():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+@pytest.mark.parametrize("impl", ["ulysses", "ring", "ulysses_flash"])
 def test_llama_trains_with_sequence_parallelism(impl):
     """End-to-end: Llama on a seq=4 mesh, loss matches the seq=1 run."""
     import deepspeed_tpu as ds
@@ -110,3 +148,34 @@ def test_llama_trains_with_sequence_parallelism(impl):
     losses_ref = run(build_mesh(data=8))
     np.testing.assert_allclose(losses_sp, losses_ref, rtol=2e-4)
     assert losses_sp[-1] < losses_sp[0]
+
+
+def test_ulysses_flash_sliding_window_parity():
+    """cfg.sliding_window threads through the all_to_all swap: post-swap
+    each shard holds the full sequence, so the kernel's global window is
+    exact."""
+    from deepspeed_tpu.models.layers import dot_product_attention
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ulysses_flash_attention
+
+    mesh = build_mesh(seq=4, data=2)
+    set_mesh(mesh)
+    q, k, v = _qkv()
+    out = jax.jit(lambda a, b, c: ulysses_flash_attention(
+        a, b, c, causal=True, mesh=mesh, block_q=16, block_k=16,
+        window=8))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True, window=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_flash_rejects_tensor_parallel():
+    from deepspeed_tpu.parallel import build_mesh, set_mesh
+    from deepspeed_tpu.sequence import ulysses_flash_attention
+
+    mesh = build_mesh(seq=2, model=2, data=2)
+    set_mesh(mesh)
+    q, k, v = _qkv()
+    with pytest.raises(NotImplementedError, match="tensor parallelism"):
+        jax.jit(lambda a, b, c: ulysses_flash_attention(
+            a, b, c, mesh=mesh))(q, k, v)
