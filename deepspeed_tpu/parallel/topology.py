@@ -133,11 +133,26 @@ def build_mesh(topology: Optional[MeshTopology] = None,
 
 _CURRENT_MESH = None
 _CURRENT_TOPOLOGY: Optional[MeshTopology] = None
+#: active token layout for dense stacked-expert MoE (engine sets this from
+#: ``{"moe": {"replicate_tokens": true}}``): True = tokens shard over
+#: ``data`` only, so MoE-internal expert-axis batch pins must not apply
+_REPLICATE_TOKENS = False
+
+
+def set_token_replication(flag: bool) -> None:
+    global _REPLICATE_TOKENS
+    _REPLICATE_TOKENS = bool(flag)
+
+
+def tokens_replicated() -> bool:
+    return _REPLICATE_TOKENS
 
 
 def set_mesh(mesh, topology: Optional[MeshTopology] = None) -> None:
     global _CURRENT_MESH, _CURRENT_TOPOLOGY
     _CURRENT_MESH = mesh
+    if mesh is None:
+        set_token_replication(False)
     if topology is None and mesh is not None:
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
         topology = MeshTopology(pipe=shape.get(PIPE_AXIS, 1), data=shape.get(DATA_AXIS, 1),

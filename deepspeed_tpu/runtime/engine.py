@@ -146,8 +146,20 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         set_mesh(mesh)
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
         # batch sharding world: seq-parallel members share samples, so seq is
-        # excluded from batch-size accounting (but not from ZeRO sharding)
-        self.dp_world_size = shape.get("data", 1) * shape.get("expert", 1)
+        # excluded from batch-size accounting (but not from ZeRO sharding).
+        # moe.replicate_tokens switches to the pure-EP layout for dense
+        # stacked-expert MoE models (tokens replicate across the expert axis;
+        # the only in-layer collective is the combine psum — the layout the
+        # XLA:CPU thunk runtime can execute inside a layer scan, and the one
+        # that avoids per-layer expert-axis batch reshards entirely):
+        self._replicate_tokens = bool(
+            ((config or {}).get("moe") or {}).get("replicate_tokens", False))
+        from ..parallel.topology import set_token_replication
+
+        set_token_replication(self._replicate_tokens)
+        self._batch_axes = ("data",) if self._replicate_tokens else BATCH_AXES
+        self.dp_world_size = shape.get("data", 1) * (
+            1 if self._replicate_tokens else shape.get("expert", 1))
         self.seq_world_size = shape.get("seq", 1)
         self.mp_world_size = shape.get("model", 1)
 
@@ -191,10 +203,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         off = self._config.zero_config.offload_optimizer
         self._offload = (off is not None
                          and str(getattr(off.device, "value", off.device)) != "none")
-        if self._offload and self.fp16_enabled:
-            raise ValueError("offload_optimizer currently supports bf16/fp32 "
-                             "(use bf16 on TPU; fp16 loss scaling is a "
-                             "device-side path)")
+        # fp16 composes with offload since r4: the compiled step produces
+        # SCALED grads, the host optimizer unscales + overflow-checks, and
+        # the dynamic-scale automaton advances host-side — the reference's
+        # default offload mode (``stage_1_and_2.py:1027-1178``).
         opt_cfg = self._config.optimizer
         #: explicit wire-compressed 1-bit path (runtime/onebit_engine.py)
         self._onebit_wire = bool(
@@ -332,9 +344,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         # [gas, batch, tokens...]: batch over data axes; with sequence
         # parallelism the token dim additionally rides the seq axis
         # (Ulysses/ring resharding happens inside the attention core).
-        self.batch_sharding = NamedSharding(mesh, PartitionSpec(None, BATCH_AXES))
+        self.batch_sharding = NamedSharding(mesh,
+                                            PartitionSpec(None, self._batch_axes))
         self._batch_seq_sharding = NamedSharding(
-            mesh, PartitionSpec(None, BATCH_AXES, SEQ_AXIS))
+            mesh, PartitionSpec(None, self._batch_axes, SEQ_AXIS))
         if self._offload:
             self._train_step = None
             self._grad_step = self._compile_grad_step()
@@ -637,22 +650,25 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         loss_fn = self.loss_fn
         gas = self.gradient_accumulation_steps
 
-        def compute_loss(params, batch, rng):
+        def compute_loss(params, batch, rng, scale):
             if loss_fn is not None:
                 loss, aux = loss_fn(params, batch, rng)
             else:
                 loss, aux = self._default_loss(params, batch, rng)
-            return loss.astype(jnp.float32), loss
+            # fp16: grads leave the device SCALED (reference scales the loss
+            # before backward, ``fp16/loss_scaler.py backward``); the host
+            # step divides them back out
+            return loss.astype(jnp.float32) * scale, loss
 
         grad_fn = jax.grad(compute_loss, has_aux=True)
 
-        def grad_step(params, batch, rng):
+        def grad_step(params, batch, rng, scale):
             if gas > 1:
                 rngs = jax.random.split(rng, gas)
 
                 def body(acc, xs):
                     mb, r = xs
-                    g, loss = grad_fn(params, mb, r)
+                    g, loss = grad_fn(params, mb, r, scale)
                     acc_g, acc_l = acc
                     return (jax.tree_util.tree_map(jnp.add, acc_g, g),
                             acc_l + loss), None
@@ -665,20 +681,31 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 loss = sum_loss / gas
             else:
                 squeezed = jax.tree_util.tree_map(lambda x: x[0], batch)
-                grads, loss = grad_fn(params, squeezed, rng)
+                grads, loss = grad_fn(params, squeezed, rng, scale)
             return grads, loss
 
         return jax.jit(grad_step,
-                       in_shardings=(self.param_shardings, None, self._replicated),
+                       in_shardings=(self.param_shardings, None,
+                                     self._replicated, self._replicated),
                        out_shardings=(self.param_shardings, self._replicated))
 
     def _offload_train_batch(self, batch):
-        """Host-optimizer step (ZeRO-Offload)."""
+        """Host-optimizer step (ZeRO-Offload; with fp16, the reference's
+        default composition ``stage_1_and_2.py:1027-1178``: scaled grads →
+        host unscale + overflow check → dynamic-scale automaton)."""
         batch = self._shape_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
-        grads, loss = self._grad_step(self.state.params, batch, step_rng)
-        new_params, overflow, grad_norm = self._host_opt.step(jax.device_get(grads))
+        ls = self.state.loss_scale
+        scale = float(jax.device_get(ls.cur_scale)) \
+            if (self.fp16_enabled and ls is not None) else 1.0
+        grads, loss = self._grad_step(self.state.params, batch, step_rng,
+                                      jnp.float32(scale))
+        new_params, overflow, grad_norm = self._host_opt.step(
+            jax.device_get(grads), loss_scale=scale)
         self._last_grad_norm = grad_norm
+        if self.fp16_enabled and ls is not None:
+            self.state = self.state.replace(
+                loss_scale=update_scale(ls, jnp.bool_(overflow)))
         if overflow:
             self.skipped_steps += 1
             self.state = self.state.replace(
@@ -911,13 +938,15 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             return loss
 
         return jax.jit(eval_step, in_shardings=(
-            self.param_shardings, NamedSharding(self.mesh, PartitionSpec(BATCH_AXES)),
+            self.param_shardings,
+            NamedSharding(self.mesh, PartitionSpec(self._batch_axes)),
             self._replicated, self._replicated), out_shardings=self._replicated)
 
     def eval_batch(self, batch: Dict[str, Any]):
         if self._eval_step is None:
             self._eval_step = self._compile_eval_step()
-        mb = jax.device_put(batch, NamedSharding(self.mesh, PartitionSpec(BATCH_AXES)))
+        mb = jax.device_put(
+            batch, NamedSharding(self.mesh, PartitionSpec(self._batch_axes)))
         # fixed rng: eval losses are reproducible call-to-call (stochastic
         # layers like MoE gating see the same noise for the same batch)
         return self._eval_step(self.state.params, mb,
@@ -1166,7 +1195,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                  "offload_param")
             engine = ZeroInfinityEngine(model, config=cfg_dict,
                                         example_batch=example_batch, rng=rng,
-                                        lr_scheduler=lr_scheduler)
+                                        lr_scheduler=lr_scheduler, mesh=mesh)
         else:
             engine = PipelineEngine(model=model, config=config,
                                     example_batch=example_batch,

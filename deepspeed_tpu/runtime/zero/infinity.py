@@ -11,27 +11,41 @@ TPU-native shape: the reference swaps individual params around autograd
 hooks; here the model is a LAYER LIST (``PipelineModule`` with
 ``num_stages=1``) and the unit of swap is a BLOCK of body layers:
 
-- body-layer params live on host as bf16 numpy, one entry per layer
-  (optionally backed by the aio module's NVMe path for the optimizer
-  moments via ``HostOffloadOptimizer``);
-- forward streams block b's params to the device while block b-1 computes
-  (double-buffered prefetch — ``jax.device_put`` is async on TPU, so the
-  H2D copy rides under the previous block's compute);
+- body-layer params live on host as bf16 numpy, PRE-STACKED per block
+  (``[block_layers, ...]`` leaves). The stacked arrays are persistent
+  staging buffers: the per-step H2D transfer is one contiguous copy per
+  leaf, with no per-step host-side gather (the reference pins its swap
+  buffers for the same reason, ``csrc/aio/py_lib``);
+- forward streams block b's params to the device while block b-1 computes.
+  The prefetch runs on a dedicated transfer thread, so the host-side copy
+  genuinely overlaps compute on every backend (on TPU it additionally
+  rides the async H2D DMA);
 - only BLOCK-BOUNDARY activations are kept; backward re-streams each
   block's params in reverse and recomputes inside the block via vjp
   (the reference trades the same recompute via activation checkpointing);
 - gradients leave the device per block (fp32 host), so the device working
   set is O(2 param blocks + boundary activations + one block's grads) —
   independent of total depth;
+- with a ``Mesh`` carrying a ``data`` axis, each streamed block is
+  ZeRO-3-SHARDED over the data axis: every leaf is flattened, padded, and
+  ``device_put`` shard-by-shard (H2D bandwidth aggregates across chips);
+  the jitted block fn reassembles the full block (XLA inserts the
+  all-gather) while the batch stays data-sharded, and block grads leave
+  the device reduce-scattered back to the flat ``data`` sharding — the
+  same gather/compute/scatter cycle the reference drives from hooks in
+  ``stage3.py:465,:846``;
+- gradient accumulation (gas>1) sums per-micro-batch gradients in the
+  host fp32 buffers before the single optimizer step;
 - the optimizer step runs on host over fp32 masters
   (``HostOffloadOptimizer``: SIMD cpu_adam, NVMe moment spill), then new
-  bf16 weights are written back to the host layer store.
+  bf16 weights are written IN PLACE into the persistent staging blocks.
 
 Enable via ``zero_optimization.offload_param: {"device": "cpu"}`` with a
 ``PipelineModule`` model; ``deepspeed_tpu.initialize`` dispatches here.
 """
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -56,15 +70,15 @@ def _to_host_bf16(tree):
 class ZeroInfinityEngine:
     """Block-streaming train engine (see module docstring).
 
-    Restrictions (v1, mirroring the reference's own composition limits for
-    param swapping): gas=1, single device, bf16 compute, no dropout rngs in
-    the streamed body, optimizer = any ``HostOffloadOptimizer`` type
-    (Adam/AdamW/Adagrad...).
+    Restrictions (v2): bf16 compute, no dropout rngs in the streamed body,
+    optimizer = any ``HostOffloadOptimizer`` type (Adam/AdamW/Adagrad...).
+    A mesh, when given, must carry exactly one axis named ``data``.
     """
 
     def __init__(self, module: PipelineModule, config: Optional[Dict] = None,
                  example_batch: Optional[Dict] = None,
-                 rng: Optional[jax.Array] = None, lr_scheduler=None):
+                 rng: Optional[jax.Array] = None, lr_scheduler=None,
+                 mesh=None):
         if module.num_stages != 1:
             raise ValueError("ZeroInfinityEngine streams a num_stages=1 "
                              "layer list (combine with pipe later)")
@@ -72,9 +86,17 @@ class ZeroInfinityEngine:
             raise ValueError("ZeroInfinityEngine needs a homogeneous body "
                              "to stream")
         self.module = module
-        self._config = DeepSpeedConfig(dict(config or {}), world_size=1)
-        if self._config.gradient_accumulation_steps != 1:
-            raise ValueError("ZeroInfinityEngine supports gas=1")
+        self.mesh = mesh
+        if mesh is not None:
+            if tuple(mesh.axis_names) != ("data",):
+                raise ValueError(
+                    "ZeroInfinityEngine shards streamed blocks over a "
+                    f"single 'data' mesh axis; got axes {mesh.axis_names}")
+            self.dp = int(mesh.shape["data"])
+        else:
+            self.dp = 1
+        self._config = DeepSpeedConfig(dict(config or {}), world_size=self.dp)
+        self.gas = int(self._config.gradient_accumulation_steps)
         opt_cfg = self._config.optimizer
         zcfg = self._config.zero_config
         pcfg = zcfg.offload_param
@@ -97,8 +119,9 @@ class ZeroInfinityEngine:
                 f"the body layer count ({self.L}); adjust block_layers")
         self.n_blocks = self.L // self.block_layers
         # initialize()'s common tail reads these (dataloader sizing etc.)
-        self.micro_batch_size = self._config.train_batch_size
-        self.dp_world_size = 1
+        self.micro_batch_size = self._config.train_micro_batch_size_per_gpu
+        self.dp_world_size = self.dp
+        self._xfer_pool: Optional[ThreadPoolExecutor] = None
 
         rng = rng if rng is not None else jax.random.PRNGKey(
             int((config or {}).get("seed", 42)))
@@ -140,7 +163,7 @@ class ZeroInfinityEngine:
             body_host.append(_to_host_bf16(p))
             del v, p  # device copy freed; host bf16 kept
         probe = jax.jit(lambda p, h: body.apply({"params": p}, h))(
-            self._layer_to_device(body_host[0]), probe)
+            jax.tree_util.tree_map(jnp.asarray, body_host[0]), probe)
         for i, (spec, mod) in enumerate(zip(module.suffix_specs,
                                             module._suffix_modules)):
             r, sub = jax.random.split(r)
@@ -163,15 +186,24 @@ class ZeroInfinityEngine:
             if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
             else jnp.asarray(a),
             {k: v for k, v in prefix_tied.items() if v})
-        #: the streamed body: host bf16, one pytree per layer
-        self.host_body = body_host
+        #: the streamed body: persistent PRE-STACKED host bf16 staging,
+        #: one pytree per block with ``[block_layers, ...]`` leaves
+        self.host_blocks: List[Any] = []
+        for b in range(self.n_blocks):
+            layers = body_host[b * self.block_layers:(b + 1) * self.block_layers]
+            self.host_blocks.append(
+                jax.tree_util.tree_map(lambda *ls: np.stack(ls), *layers))
+        del body_host
+
+        if self.dp > 1:
+            self._init_dp_sharding()
 
         # ---- host optimizer over the FULL fp32 state -------------------
         full = {"edges": jax.tree_util.tree_map(
                     lambda a: np.asarray(a, np.float32), self.edge_params),
                 "body": [jax.tree_util.tree_map(
-                    lambda a: np.asarray(a, np.float32), lp)
-                    for lp in body_host]}
+                    lambda a: np.asarray(a, np.float32), blk)
+                    for blk in self.host_blocks]}
         sched_cfg = self._config.scheduler
         if lr_scheduler is None and sched_cfg is not None \
                 and sched_cfg.type is not None:
@@ -190,23 +222,124 @@ class ZeroInfinityEngine:
         log_dist(f"ZeRO-Infinity: {self.L} body layers on host "
                  f"({self._host_bytes() / 1e6:.1f} MB bf16), streamed in "
                  f"{self.n_blocks} blocks of {self.block_layers}; device "
-                 f"holds 2 blocks + edges", ranks=[0])
+                 f"holds 2 blocks + edges; dp={self.dp}, gas={self.gas}",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
+    # host body views (per-layer API kept for checkpoints/tools/tests)
+    # ------------------------------------------------------------------
+
+    @property
+    def host_body(self) -> List[Any]:
+        out = []
+        for blk in self.host_blocks:
+            for i in range(self.block_layers):
+                out.append(jax.tree_util.tree_map(lambda a: a[i], blk))
+        return out
+
+    @host_body.setter
+    def host_body(self, layers: List[Any]):
+        self.host_blocks = []
+        for b in range(self.n_blocks):
+            ls = layers[b * self.block_layers:(b + 1) * self.block_layers]
+            self.host_blocks.append(
+                jax.tree_util.tree_map(lambda *xs: np.stack(xs), *ls))
+        if self.dp > 1:
+            self._rewire_dp_staging()
 
     def _host_bytes(self) -> int:
-        return sum(int(a.nbytes) for lp in self.host_body
-                   for a in jax.tree_util.tree_leaves(lp))
+        return sum(int(a.nbytes) for blk in self.host_blocks
+                   for a in jax.tree_util.tree_leaves(blk))
 
-    def _layer_to_device(self, layer_host):
-        return jax.tree_util.tree_map(lambda a: jnp.asarray(a), layer_host)
+    # ------------------------------------------------------------------
+    # dp>1: ZeRO-3-style flat 'data' sharding of the streamed blocks
+    # ------------------------------------------------------------------
+
+    def _init_dp_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._shard_flat = NamedSharding(self.mesh, P("data"))
+        self._shard_batch = NamedSharding(self.mesh, P("data"))
+        self._repl = NamedSharding(self.mesh, P())
+        leaves0, self._block_treedef = jax.tree_util.tree_flatten(
+            self.host_blocks[0])
+        self._leaf_shapes = [l.shape for l in leaves0]
+        self._leaf_sizes = [int(l.size) for l in leaves0]
+        self._leaf_chunks = [-(-n // self.dp) for n in self._leaf_sizes]
+        self._rewire_dp_staging()
+        self.edge_params = jax.device_put(self.edge_params, self._repl)
+
+    def _rewire_dp_staging(self):
+        """Move the block store into padded flat staging buffers and turn
+        ``host_blocks``' leaves into reshaped VIEWS of them — one host copy
+        of the body, shared between the per-layer API and the per-shard
+        ``device_put`` path (writebacks through either alias the other)."""
+        self._flat_blocks: List[List[np.ndarray]] = []
+        new_blocks = []
+        for blk in self.host_blocks:
+            flats, views = [], []
+            for leaf, n, c, s in zip(jax.tree_util.tree_leaves(blk),
+                                     self._leaf_sizes, self._leaf_chunks,
+                                     self._leaf_shapes):
+                buf = np.zeros(self.dp * c, dtype=leaf.dtype)
+                buf[:n] = np.ravel(leaf)
+                flats.append(buf)
+                views.append(buf[:n].reshape(s))
+            self._flat_blocks.append(flats)
+            new_blocks.append(jax.tree_util.tree_unflatten(
+                self._block_treedef, views))
+        self.host_blocks = new_blocks
+
+    # ------------------------------------------------------------------
+    # H2D streaming
+    # ------------------------------------------------------------------
 
     def _block_to_device(self, b: int):
-        """Stack block b's layers into [k, ...] leaves and start the H2D
-        copy (async on TPU — this IS the prefetch)."""
-        layers = self.host_body[b * self.block_layers:(b + 1) * self.block_layers]
-        stacked = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *layers)
-        return jax.tree_util.tree_map(jax.device_put, stacked)
+        """Start block b's H2D copy from the persistent staging buffers.
+
+        dp=1: whole stacked leaves. dp>1: each flat leaf is device_put
+        shard-by-shard (1/dp per device) and assembled into a global
+        data-sharded array — the all-gather happens inside the jitted
+        block fn, so H2D bandwidth aggregates across the mesh.
+        """
+        if self.dp == 1:
+            return jax.tree_util.tree_map(jax.device_put, self.host_blocks[b])
+        devs = list(self.mesh.devices.ravel())
+        out = []
+        for buf, c in zip(self._flat_blocks[b], self._leaf_chunks):
+            shards = [jax.device_put(buf[i * c:(i + 1) * c], d)
+                      for i, d in enumerate(devs)]
+            out.append(jax.make_array_from_single_device_arrays(
+                (self.dp * c,), self._shard_flat, shards))
+        return out
+
+    @property
+    def _xfer(self) -> ThreadPoolExecutor:
+        """Lazy one-worker transfer executor (created on first prefetch so a
+        never-prefetching engine costs no thread; shut down in __del__ so
+        repeatedly-constructed engines don't accumulate non-daemon threads
+        that also pin the host block buffers against collection)."""
+        if self._xfer_pool is None:
+            self._xfer_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ds_inf_xfer")
+        return self._xfer_pool
+
+    def __del__(self):
+        pool = getattr(self, "_xfer_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _fetch(self, b: int, prefetch: bool):
+        """Issue block b's transfer on the dedicated thread (overlaps the
+        host-side copy with compute even on backends with sync device_put);
+        serial mode runs it inline."""
+        if prefetch:
+            return self._xfer.submit(self._block_to_device, b)
+        return None
+
+    @staticmethod
+    def _resolve(fut, engine, b):
+        return fut.result() if fut is not None else engine._block_to_device(b)
 
     def _build_jits(self):
         module = self.module
@@ -214,17 +347,39 @@ class ZeroInfinityEngine:
         def fwd_edges_prefix(edges, x):
             return module.apply_prefix(edges, x)
 
-        def fwd_block(block_params, h):
-            return module.apply_stage(block_params, h)
-
         def loss_suffix(edges, h, labels):
             out = module.apply_suffix(edges, h)
             return module.loss_fn(out, labels)
 
+        if self.dp == 1:
+            def fwd_block(block_params, h):
+                return module.apply_stage(block_params, h)
+
+            self._j_block = jax.jit(fwd_block)
+            self._j_block_vjp = jax.jit(
+                lambda bp, h, g: jax.vjp(fwd_block, bp, h)[1](g))
+        else:
+            treedef = self._block_treedef
+            shapes, sizes = self._leaf_shapes, self._leaf_sizes
+
+            def fwd_block_flat(flat_leaves, h):
+                # flat[:n].reshape(...) forces the all-gather of each
+                # data-sharded leaf; the batch stays sharded
+                leaves = [f[:n].reshape(s)
+                          for f, n, s in zip(flat_leaves, sizes, shapes)]
+                bp = jax.tree_util.tree_unflatten(treedef, leaves)
+                return module.apply_stage(bp, h)
+
+            n_leaves = len(sizes)
+            self._j_block = jax.jit(fwd_block_flat)
+            # grads leave the device reduce-scattered back to the flat
+            # 'data' sharding (the ZeRO grad partition)
+            self._j_block_vjp = jax.jit(
+                lambda fl, h, g: jax.vjp(fwd_block_flat, fl, h)[1](g),
+                out_shardings=([self._shard_flat] * n_leaves,
+                               self._shard_batch))
+
         self._j_prefix = jax.jit(fwd_edges_prefix)
-        self._j_block = jax.jit(fwd_block)
-        self._j_block_vjp = jax.jit(
-            lambda bp, h, g: jax.vjp(fwd_block, bp, h)[1](g))
         self._j_suffix_grad = jax.jit(
             jax.value_and_grad(loss_suffix, argnums=(0, 1)))
         self._j_prefix_grad = jax.jit(
@@ -233,35 +388,38 @@ class ZeroInfinityEngine:
 
     # ------------------------------------------------------------------
 
-    def train_batch(self, batch=None, data_iter=None):
-        if batch is None:
-            batch = next(data_iter)
-        if not isinstance(batch, dict):
-            batch = {"inputs": batch[0], "labels": batch[1]}
-        x = jnp.asarray(batch["inputs"])
-        labels = jnp.asarray(batch["labels"])
-        t0 = time.perf_counter()
-        self.last_peak_device_bytes = 0
+    def _grads_to_host_block(self, g_bp) -> Any:
+        """Device block-grads → host fp32 stacked tree ``[k, ...]``."""
+        if self.dp == 1:
+            return jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a), np.float32), g_bp)
+        leaves = [np.asarray(jax.device_get(f), np.float32)[:n].reshape(s)
+                  for f, n, s in zip(g_bp, self._leaf_sizes,
+                                     self._leaf_shapes)]
+        return jax.tree_util.tree_unflatten(self._block_treedef, leaves)
 
-        def mark():
-            if self.track_device_memory:
-                live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                           for a in jax.live_arrays())
-                self.last_peak_device_bytes = max(
-                    self.last_peak_device_bytes, live)
+    def _mark(self):
+        if self.track_device_memory:
+            live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in jax.live_arrays())
+            self.last_peak_device_bytes = max(
+                self.last_peak_device_bytes, live)
 
-        # ---- forward: stream blocks with 1-deep prefetch ----------------
+    def _micro_grads(self, x, labels):
+        """One micro-batch: streamed forward + reverse-streamed backward.
+        Returns (loss, host fp32 grads {'edges', 'body': [blocked trees]})."""
+        # ---- forward: stream blocks with 1-deep threaded prefetch -------
         h = self._j_prefix(self.edge_params, x)
         boundaries = [h]
         cur = self._block_to_device(0)
         for b in range(self.n_blocks):
-            nxt = self._block_to_device(b + 1) if (
-                self.prefetch and b + 1 < self.n_blocks) else None
+            fut = self._fetch(b + 1, self.prefetch) \
+                if b + 1 < self.n_blocks else None
             h = self._j_block(cur, h)
             boundaries.append(h)
-            mark()
-            cur = nxt if nxt is not None else (
-                self._block_to_device(b + 1) if b + 1 < self.n_blocks else None)
+            self._mark()
+            cur = self._resolve(fut, self, b + 1) \
+                if b + 1 < self.n_blocks else None
 
         # ---- loss + suffix/last-boundary grads -------------------------
         (loss, (g_edges_suffix, g_h)) = self._j_suffix_grad(
@@ -271,15 +429,12 @@ class ZeroInfinityEngine:
         body_grads_host: List[Any] = [None] * self.n_blocks
         cur = self._block_to_device(self.n_blocks - 1)
         for b in reversed(range(self.n_blocks)):
-            nxt = self._block_to_device(b - 1) if (self.prefetch and b > 0) \
-                else None
+            fut = self._fetch(b - 1, self.prefetch) if b > 0 else None
             g_bp, g_h = self._j_block_vjp(cur, boundaries[b], g_h)
-            mark()
-            body_grads_host[b] = jax.tree_util.tree_map(
-                lambda a: np.asarray(jax.device_get(a), np.float32), g_bp)
+            self._mark()
+            body_grads_host[b] = self._grads_to_host_block(g_bp)
             del g_bp
-            cur = nxt if nxt is not None else (
-                self._block_to_device(b - 1) if b > 0 else None)
+            cur = self._resolve(fut, self, b - 1) if b > 0 else None
         g_edges_prefix = self._j_prefix_grad(self.edge_params, x, g_h)
 
         # combine edge grads (suffix/tied from the loss grad; prefix/tied
@@ -288,29 +443,79 @@ class ZeroInfinityEngine:
             lambda a, b2: np.asarray(jax.device_get(a), np.float32)
             + np.asarray(jax.device_get(b2), np.float32),
             g_edges_suffix, g_edges_prefix)
+        return loss, {"edges": g_edges, "body": body_grads_host}
 
-        # per-layer grads from the [k, ...] block stacks
-        g_body_layers = []
-        for b in range(self.n_blocks):
-            for k in range(self.block_layers):
-                g_body_layers.append(jax.tree_util.tree_map(
-                    lambda a: a[k], body_grads_host[b]))
+    @staticmethod
+    def _as_xy(batch):
+        if not isinstance(batch, dict):
+            batch = {"inputs": batch[0], "labels": batch[1]}
+        return np.asarray(batch["inputs"]), np.asarray(batch["labels"])
 
-        grads = {"edges": g_edges, "body": g_body_layers}
+    def train_batch(self, batch=None, data_iter=None):
+        t0 = time.perf_counter()
+        self.last_peak_device_bytes = 0
 
-        # ---- host optimizer step + writeback ---------------------------
+        # Reference semantics (engine.py train_batch): from an iterator,
+        # consume gas MICRO-batches (the dataloader yields micro*dp rows);
+        # an explicit batch carries the full global step and is split here.
+        if batch is None:
+            micros = [self._as_xy(next(data_iter)) for _ in range(self.gas)]
+        else:
+            inputs, labels = self._as_xy(batch)
+            n = inputs.shape[0]
+            if n % self.gas != 0:
+                raise ValueError(
+                    f"batch leading dim {n} must be divisible by "
+                    f"gradient_accumulation_steps={self.gas}")
+            m = n // self.gas
+            micros = [(inputs[g * m:(g + 1) * m], labels[g * m:(g + 1) * m])
+                      for g in range(self.gas)]
+        if self.dp > 1 and any(x.shape[0] % self.dp for x, _ in micros):
+            raise ValueError(
+                f"micro-batch {micros[0][0].shape[0]} must be divisible by "
+                f"dp={self.dp}")
+
+        def put(a):
+            a = jnp.asarray(a)
+            return jax.device_put(a, self._shard_batch) if self.dp > 1 else a
+
+        grads = None
+        loss_sum = 0.0
+        t_stream = time.perf_counter()
+        for x_np, y_np in micros:
+            loss, micro = self._micro_grads(put(x_np), put(y_np))
+            loss_sum += float(loss)
+            if grads is None:
+                grads = micro
+            else:
+                grads = jax.tree_util.tree_map(np.add, grads, micro)
+        #: streaming phase (block H2D + compute + grad D2H) — the part the
+        #: threaded prefetch overlaps; the host optimizer step is separate
+        self._last_stream_s = time.perf_counter() - t_stream
+        if self.gas > 1:
+            grads = jax.tree_util.tree_map(
+                lambda a: a / self.gas, grads)
+        loss = loss_sum / self.gas if self.gas > 1 else loss
+
+        # ---- host optimizer step + in-place writeback ------------------
         new_params, overflow, self._last_grad_norm = self._host_opt.step(
             grads, loss_scale=self.loss_scale)
         if not overflow:
             import ml_dtypes
 
-            self.edge_params = jax.tree_util.tree_map(
+            edges = jax.tree_util.tree_map(
                 lambda a: jnp.asarray(a, jnp.bfloat16)
                 if np.issubdtype(np.asarray(a).dtype, np.floating)
                 else jnp.asarray(a), new_params["edges"])
-            self.host_body = [jax.tree_util.tree_map(
-                lambda a: np.asarray(a).astype(ml_dtypes.bfloat16), lp)
-                for lp in new_params["body"]]
+            self.edge_params = jax.device_put(edges, self._repl) \
+                if self.dp > 1 else edges
+            # in-place into the persistent staging (dp>1: the leaves are
+            # views of the flat shard buffers, so this write lands there too)
+            for blk_dst, blk_new in zip(self.host_blocks,
+                                        new_params["body"]):
+                jax.tree_util.tree_map(
+                    lambda dst, src: np.copyto(dst, src, casting="unsafe"),
+                    blk_dst, blk_new)
         self.global_steps += 1
         self._last_step_s = time.perf_counter() - t0
         return loss
@@ -359,20 +564,24 @@ class ZeroInfinityEngine:
                            else np.zeros_like(self._host_opt.master[li])
                            for li in range(n)] for mi in range(nbanks)]}
         self._host_opt.load_state_dict(sd)
-        # rebuild the working copies (bf16 host body + device edges) from
+        # rebuild the working copies (bf16 host blocks + device edges) from
         # the restored fp32 masters
         new_leaves = [m.reshape(shape).astype(dtype) for m, shape, dtype in
                       zip(self._host_opt.master, self._host_opt._shapes,
                           self._host_opt._dtypes)]
         full = jax.tree_util.tree_unflatten(self._host_opt._treedef,
                                             new_leaves)
-        self.edge_params = jax.tree_util.tree_map(
+        edges = jax.tree_util.tree_map(
             lambda a: jnp.asarray(a, jnp.bfloat16)
             if np.issubdtype(np.asarray(a).dtype, np.floating)
             else jnp.asarray(a), full["edges"])
-        self.host_body = [jax.tree_util.tree_map(
-            lambda a: np.asarray(a).astype(ml_dtypes.bfloat16), lp)
-            for lp in full["body"]]
+        self.edge_params = jax.device_put(edges, self._repl) \
+            if self.dp > 1 else edges
+        self.host_blocks = [jax.tree_util.tree_map(
+            lambda a: np.asarray(a).astype(ml_dtypes.bfloat16), blk)
+            for blk in full["body"]]
+        if self.dp > 1:
+            self._rewire_dp_staging()
         self.global_steps = int(z["global_steps"])
         return load_dir, {"global_steps": self.global_steps}
 

@@ -36,7 +36,6 @@ COMPRESS = {"compression_training": {"sparse_pruning": {
 
 @pytest.mark.parametrize("config,match", [
     # offload_optimizer exclusions
-    ({**OPT, **OFFLOAD, "fp16": {"enabled": True}}, "bf16/fp32"),
     ({**OPT, **OFFLOAD, **MOQ}, "fused device"),
     ({**OPT, **OFFLOAD, **COMPRESS}, "fused"),
     ({**OPT, **OFFLOAD, **PLD}, "offload_optimizer"),

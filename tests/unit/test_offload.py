@@ -102,3 +102,31 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     assert e2._host_opt.step_count == 3
     cont2 = [float(e2.train_batch(batch=batch)) for _ in range(2)]
     np.testing.assert_allclose(cont2, cont1, rtol=1e-5)
+
+
+def test_fp16_offload_trains_and_scales():
+    """fp16 x offload_optimizer (r4, the reference's DEFAULT offload mode,
+    stage_1_and_2.py:1027-1178): scaled grads leave the device, the host
+    unscales + overflow-checks, the dynamic-scale automaton advances
+    host-side. Loss trajectory must track the fp32 offload run."""
+    cfg16 = _config("cpu")
+    cfg16["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    losses16, engine = _run(cfg16, steps=6)
+    assert engine._offload and engine.fp16_enabled
+    assert engine.loss_scale == 2.0 ** 8  # no overflow at this power
+    losses32, _ = _run(_config("cpu"), steps=6)
+    np.testing.assert_allclose(losses16, losses32, rtol=0.05, atol=0.05)
+    assert losses16[-1] < losses16[0]
+
+
+def test_fp16_offload_overflow_skips_and_halves_scale():
+    """A crafted overflow (astronomical initial scale -> inf scaled grads)
+    must skip the step and halve the scale, reference DynamicLossScaler
+    semantics."""
+    cfg16 = _config("cpu")
+    # 2^40 overflows fp16's 65504 max immediately
+    cfg16["fp16"] = {"enabled": True, "initial_scale_power": 40,
+                     "hysteresis": 1}
+    losses, engine = _run(cfg16, steps=2)
+    assert engine.skipped_steps >= 1
+    assert engine.loss_scale < 2.0 ** 40

@@ -61,19 +61,25 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
     engine = ds.init_inference(model, params=params, dtype="bf16",
                                max_out_tokens=prompt + new)
 
+    def best_of(fn, n=3):
+        """min over repeats — single-shot timings at millisecond scale are
+        jitter-dominated and produced dt<ttft (null throughput) records."""
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
     # TTFT: generation of ONE new token = prefill + single decode step
     np.asarray(engine.generate(ids, max_new_tokens=1))  # compile
-    t0 = time.perf_counter()
-    np.asarray(engine.generate(ids, max_new_tokens=1))
-    ttft = time.perf_counter() - t0
+    ttft = best_of(lambda: np.asarray(engine.generate(ids, max_new_tokens=1)))
 
     # decode throughput from the DIFFERENCE of two full runs (new vs 1 new
     # token): (new - 1) extra decode steps; avoids subtracting measurements
     # from differently-compiled programs' overheads
     np.asarray(engine.generate(ids, max_new_tokens=new))  # compile
-    t0 = time.perf_counter()
-    np.asarray(engine.generate(ids, max_new_tokens=new))
-    dt = time.perf_counter() - t0
+    dt = best_of(lambda: np.asarray(engine.generate(ids, max_new_tokens=new)))
     extra_steps = new - 1
     decode_tps = (batch * extra_steps / (dt - ttft)
                   if extra_steps > 0 and dt > ttft else None)
@@ -140,7 +146,10 @@ def main():
                                      "120" if args.tiny else "420"))
     # latency point (bs=1), the reference-blog-like serving point, and a
     # throughput point — TTFT + decode t/s at each
-    points = ([(1, 16, 8), (2, 16, 8)] if args.tiny
+    # tiny decode runs long enough (64 new tokens) that the 2-run
+    # difference is decode-dominated — 8 tokens sat inside timer jitter
+    # and produced null throughput records
+    points = ([(1, 16, 64), (2, 16, 64)] if args.tiny
               else [(1, 128, 128), (8, 512, 128), (32, 1024, 128)])
 
     summary = {"metric": "llama400m_decode", "impl": args.impl, "points": []}

@@ -1,6 +1,7 @@
 """Run ONE bench.py candidate on the real chip (iteration helper).
 
-Usage: python tools/bench_one.py <tag> <remat_policy> <batch> [steps]
+Usage: python tools/bench_one.py <tag> <remat_policy> <batch> [key=value ...]
+  extras: fq=<flash block_q> fk=<flash block_k> padam=1 steps=<n>
 Prints the candidate's JSON record. bench.py remains the driver entry point;
 this exists so perf iteration does not pay for the full candidate ladder.
 """
@@ -15,9 +16,17 @@ import bench
 
 
 def main():
-    tag, policy, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
-    steps = int(sys.argv[4]) if len(sys.argv) > 4 else 8
-    rec = bench.run_candidate(tag, policy, batch, steps=steps)
+    spec = {"tag": sys.argv[1], "policy": sys.argv[2], "batch": int(sys.argv[3])}
+    steps = 8
+    for kv in sys.argv[4:]:
+        k, v = kv.split("=", 1)
+        if k == "steps":
+            steps = int(v)
+        elif k == "padam":
+            spec[k] = v not in ("0", "false", "")
+        else:
+            spec[k] = int(v)
+    rec = bench.run_candidate(spec, steps=steps)
     print(json.dumps(rec))
 
 

@@ -54,9 +54,10 @@ def run_capped(cmd, cap_s, out_path=None):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--tag", default="r03")
+    ap.add_argument("--tag", default="r04")
     ap.add_argument("--skip", default="",
-                    help="comma list: profile,bench,decode,infinity,longctx")
+                    help="comma list: kernels,profile,bench,decode,"
+                         "infinity,longctx")
     ap.add_argument("--probe_s", type=float, default=60.0)
     args = ap.parse_args()
     skip = set(filter(None, args.skip.split(",")))
@@ -81,6 +82,8 @@ def main():
     t = args.tag
     steps = {}
     plan = [
+        ("kernels", [py, "tools/bench_kernels.py"], 1200,
+         f"KERNELS_{t}.json"),
         ("profile", [py, "tools/profile_train.py", "--quick"], 1500,
          f"PROFILE_{t}.json"),
         ("bench", [py, "bench.py"], 1800, f"BENCH_{t}_local.json"),

@@ -1,0 +1,67 @@
+"""Background TPU watcher: probe until the backend answers, then sweep.
+
+Rounds 2-3 lost their entire measurement window to a TPU backend outage;
+the round-3 postmortem (TPU_DOWN_r03.log) showed every jax.devices() call
+hanging past 300 s. This watcher runs from minute zero of the round:
+
+  - every --interval_s (default 420) it probes jax.devices() in a capped
+    subprocess (a hung backend costs one subprocess, not the watcher)
+  - every probe is appended to --log (default TPU_DOWN_<tag>.log) so a
+    full-round outage leaves committed evidence, as in round 3
+  - the moment a probe succeeds it execs tools/chip_sweep.py --tag <tag>
+    and exits, leaving the sweep artifacts in the repo root
+
+Usage: python tools/chip_watch.py [--tag r04] [--interval_s 420]
+"""
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = (
+    "import json, time\nt0=time.time()\nimport jax\nd=jax.devices()\n"
+    "print(json.dumps({'n': len(d), 'kind': str(d[0]),"
+    " 'init_s': round(time.time()-t0,1)}))\n"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", default="r04")
+    ap.add_argument("--interval_s", type=float, default=420.0)
+    ap.add_argument("--probe_s", type=float, default=120.0)
+    ap.add_argument("--log", default=None)
+    args = ap.parse_args()
+    log_path = args.log or os.path.join(REPO, f"TPU_DOWN_{args.tag}.log")
+    py = sys.executable
+
+    attempt = 0
+    while True:
+        attempt += 1
+        stamp = datetime.datetime.now().strftime("%H:%M:%S")
+        try:
+            r = subprocess.run([py, "-c", PROBE], capture_output=True,
+                               text=True, timeout=args.probe_s)
+            up = r.returncode == 0 and "{" in r.stdout
+            note = r.stdout.strip() if up else (
+                (r.stderr.strip().splitlines() or ["no output"])[-1][:200])
+        except subprocess.TimeoutExpired:
+            up, note = False, f"probe hung past {args.probe_s:.0f}s timeout"
+        with open(log_path, "a") as f:
+            f.write(f"{stamp} probe attempt {attempt}: "
+                    f"{'UP ' + note if up else note}\n")
+        if up:
+            print(f"chip_watch: backend UP at attempt {attempt}: {note}",
+                  file=sys.stderr, flush=True)
+            os.execv(py, [py, os.path.join(REPO, "tools", "chip_sweep.py"),
+                          "--tag", args.tag])
+        time.sleep(args.interval_s)
+
+
+if __name__ == "__main__":
+    main()
