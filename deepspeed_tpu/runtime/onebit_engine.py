@@ -31,10 +31,12 @@ over the batch axes, so the compressed arrays are literally what crosses
 the interconnect.
 
 Restrictions (reference has the same shape): pure data parallelism —
-ZeRO stage 0, no model/seq axes, bf16/fp32 (no loss scaling). Gradient
-accumulation composes (r3): local grads accumulate over microbatches with
-no collectives in the scan, then ONE compressed exchange per optimizer
-step.
+ZeRO stage 0, no model/seq axes. Gradient accumulation composes (r3):
+local grads accumulate over microbatches with no collectives in the scan,
+then ONE compressed exchange per optimizer step. fp16 composes (r4): the
+local loss is scaled before backward and the scaled grads are unscaled +
+overflow-checked globally BEFORE any state (momentum, error feedback)
+advances; an overflow step reverts everything and halves the scale.
 """
 
 from typing import Any, NamedTuple
@@ -83,8 +85,12 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
         raise ValueError("compressed 1-bit training requires ZeRO stage 0 "
                          "(params replicated; the compressed quantity is the "
                          "full momentum)")
-    if engine.fp16_enabled:
-        raise ValueError("use bf16/fp32 with compressed 1-bit training")
+    # fp16 composes since r4: the local loss is scaled before backward, the
+    # scaled local grads are unscaled + overflow-checked GLOBALLY before any
+    # state (momentum, error feedback) advances — a skipped step must leave
+    # the error-compensation buffers untouched or the compression would
+    # absorb inf/nan into every later exchange
+    fp16 = engine.fp16_enabled
 
     axes = tuple(a for a in ("data", "expert") if shape.get(a, 1) > 1) or ("data",)
     world = int(np.prod([shape.get(a, 1) for a in axes]))
@@ -125,16 +131,32 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
     local_loss = make_local_loss(engine)
     gas = engine.gradient_accumulation_steps
 
-    def spmd(params, mu, nu, werr, serr, vint, vcnt, count, batch, rng):
+    def spmd(params, mu, nu, werr, serr, vint, vcnt, count, batch, rng,
+             lscale):
         # per-rank: lose the leading sharded axis of the error buffers
         werr, serr = werr[0], serr[0]
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_tuple))
         # gas > 1: LOCAL grads accumulate over microbatches (no collectives
-        # inside the scan), then ONE compressed exchange per optimizer step
-        loss_local, g = accumulate_local_grads(local_loss, params, batch,
+        # inside the scan), then ONE compressed exchange per optimizer step.
+        # fp16: backward runs on the SCALED loss; grads unscale right here
+        scaled_loss = (lambda p, mb, r: local_loss(p, mb, r) * lscale) \
+            if fp16 else local_loss
+        loss_local, g = accumulate_local_grads(scaled_loss, params, batch,
                                                rng, gas)
+        if fp16:
+            loss_local = loss_local / lscale
         loss = jax.lax.pmean(loss_local, axis_tuple)
         flat_g = jnp.pad(ravel_pytree(g)[0], (0, n_pad - n))
+        if fp16:
+            flat_g = flat_g / lscale
+        # GLOBAL overflow verdict before any state advances — fp16 only:
+        # bf16/fp32 keep the pre-r4 behavior (overflow never skips; a NaN
+        # surfaces in the loss), matching the generic engine path
+        if fp16:
+            ov_local = (~jnp.isfinite(flat_g).all()).astype(jnp.int32)
+            ov = jax.lax.psum(ov_local, axis_tuple) > 0
+        else:
+            ov = jnp.bool_(False)
         # monitoring: norm of the MEAN gradient (exact in warmup; in the
         # compression phase the mean is never materialized, so this reports
         # the norm of the averaged-by-psum local grads, which equals it)
@@ -219,24 +241,44 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
             direction = jnp.pad(ravel_pytree(scaled)[0], (0, n_pad - n))
         new_flat = flat_p_pad - lr_t * direction
         new_params = unravel(new_flat[:n])
+        # overflow: EVERY piece of advanced state reverts (params, both
+        # moments, the error-feedback buffers, the 0/1-Adam interval) — a
+        # jnp.where select, so the discarded NaN-laden values never land
+        old_new = [(params, new_params), (mu, mu2), (nu, nu2),
+                   (werr, werr2), (serr, serr2), (vint, vint2),
+                   (vcnt, vcnt2)]
+        kept = [jax.tree_util.tree_map(
+            lambda o, nw: jnp.where(ov, o, nw), o, nw) for o, nw in old_new]
+        new_params, mu2, nu2, werr2, serr2, vint2, vcnt2 = kept
         return (new_params, mu2, nu2, werr2[None], serr2[None], vint2, vcnt2,
-                loss, grad_norm)
+                loss, grad_norm, ov)
 
     def train_step(state, batch, rng):
         count = state.step + 1
         mu, nu, werr, serr, vint, vcnt = state.opt_state
+        ls = state.loss_scale
+        lscale = ls.cur_scale if (fp16 and ls is not None) \
+            else jnp.float32(1.0)
         fn = jax.shard_map(
             spmd, mesh=mesh, axis_names=frozenset(axes),
             in_specs=(P(), P(), P(), P(axes), P(axes), P(), P(), P(),
-                      P(None, axes), P()),
-            out_specs=(P(), P(), P(), P(axes), P(axes), P(), P(), P(), P()),
+                      P(None, axes), P(), P()),
+            out_specs=(P(), P(), P(), P(axes), P(axes), P(), P(), P(), P(),
+                       P()),
             check_vma=False)
         (new_params, mu2, nu2, werr2, serr2, vint2, vcnt2, loss,
-         grad_norm) = fn(state.params, mu, nu, werr, serr, vint, vcnt, count,
-                         batch, rng)
+         grad_norm, ov) = fn(state.params, mu, nu, werr, serr, vint, vcnt,
+                             count, batch, rng, lscale)
+        new_ls = ls
+        if fp16 and ls is not None:
+            from .fp16.loss_scaler import update_scale
+
+            new_ls = update_scale(ls, ov)
         new_state = state.replace(
-            step=count, params=new_params,
-            opt_state=OneBitWireState(mu2, nu2, werr2, serr2, vint2, vcnt2))
-        return new_state, (loss, grad_norm), jnp.bool_(False)
+            step=jnp.where(ov, state.step, count), params=new_params,
+            opt_state=OneBitWireState(mu2, nu2, werr2, serr2, vint2, vcnt2),
+            loss_scale=new_ls,
+            skipped_steps=state.skipped_steps + ov.astype(jnp.int32))
+        return new_state, (loss, grad_norm), ov
 
     return opt_state, opt_shardings, train_step

@@ -100,7 +100,10 @@ def ulysses_flash_attention(q, k, v, causal: bool = True, mesh=None,
     inverse all_to_all restores token sharding. Backward differentiates
     through (all_to_all transposes to itself on the reverse permutation).
 
-    Head count must divide the ``seq`` axis size into whole heads.
+    Divisibility: with tensor parallelism (``model`` axis = tp > 1, r4)
+    heads split over TP first, so ``H % tp == 0`` and the PER-TP-SHARD
+    head count must divide the ``seq`` axis (``(H // tp) % sp == 0``);
+    without TP, plain ``H % sp == 0``.
     """
     from ..ops.pallas.flash_attention import flash_attention
 
@@ -109,19 +112,21 @@ def ulysses_flash_attention(q, k, v, causal: bool = True, mesh=None,
     if sp <= 1:
         return flash_attention(q, k, v, causal=causal, block_q=block_q,
                                block_k=block_k, window=window)
-    if _axis_size(mesh, "model") > 1:
-        # a Pallas call cannot be partitioned over the auto model axis:
-        # TP-sharded heads would be gathered per shard (duplicated compute)
-        raise NotImplementedError(
-            "ulysses_flash does not compose with tensor parallelism "
-            "(model axis > 1): the per-shard flash kernel cannot be "
-            "partitioned over TP heads; use attention_impl='ulysses' "
-            "(XLA core) or ring attention")
+    # TP composition (r4, lifting the r3 refusal): the Pallas call cannot be
+    # partitioned over an AUTO model axis, so when tp > 1 the shard_map goes
+    # manual over BOTH axes — heads shard explicitly over `model` (exact:
+    # heads are independent), tokens over `seq`, and each (seq, model) shard
+    # runs the kernel on its full-sequence / local-head block.
+    tp = _axis_size(mesh, "model")
     H = q.shape[2]
-    if H % sp:
+    if tp > 1 and H % tp:
         raise ValueError(f"ulysses_flash needs head count ({H}) divisible "
-                         f"by the seq axis ({sp}); use ring attention for "
-                         "head-count-independent scaling")
+                         f"by the model axis ({tp})")
+    if (H // max(tp, 1)) % sp:
+        raise ValueError(f"ulysses_flash needs per-TP-shard head count "
+                         f"({H}//{tp}) divisible by the seq axis ({sp}); "
+                         "use ring attention for head-count-independent "
+                         "scaling")
     if q.shape[1] % sp:
         raise ValueError(f"sequence length {q.shape[1]} not divisible by "
                          f"seq axis size {sp}")
@@ -140,9 +145,14 @@ def ulysses_flash_attention(q, k, v, causal: bool = True, mesh=None,
         return jax.lax.all_to_all(out, "seq", split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    spec = P(None, "seq")
+    if tp > 1:
+        spec = P(None, "seq", "model", None)
+        manual = frozenset({"seq", "model"})
+    else:
+        spec = P(None, "seq")
+        manual = frozenset({"seq"})
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names=frozenset({"seq"}),
+                       out_specs=spec, axis_names=manual,
                        check_vma=False)
     if not any(isinstance(x, jax.core.Tracer) for x in (q, k, v)):
         return jax.jit(fn)(q, k, v)  # partial-manual needs a jit trace

@@ -42,7 +42,6 @@ COMPRESS = {"compression_training": {"sparse_pruning": {
     ({**OPT, **OFFLOAD, "sparse_gradients": True}, "does not compose"),
     # 1-bit wire exclusions
     ({**WIRE, "zero_optimization": {"stage": 2}}, "ZeRO stage 0"),
-    ({**WIRE, "fp16": {"enabled": True}}, "bf16/fp32"),
     ({**WIRE, **MOQ}, "does not compose"),
     ({**WIRE, **PLD}, "does not compose|pld"),
     ({**WIRE, **COMPRESS}, "does not compose"),

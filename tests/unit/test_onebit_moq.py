@@ -271,3 +271,51 @@ def test_onebit_wire_with_gradient_accumulation():
     losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
     assert losses[-1] < losses[0] - 1.0, losses
     assert "compressed_allreduce" in comms_logger.comms_dict
+
+
+def test_onebit_wire_fp16_trains_and_skips_on_overflow():
+    """r4: fp16 composes with the compressed wire — the local loss is
+    scaled before backward, scaled grads unscale + overflow-check globally
+    BEFORE the error-feedback buffers advance, and the dynamic-scale
+    automaton rides in TrainState.loss_scale."""
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(0, cfg.vocab_size, (16, 16)),
+             "labels": rs.randint(0, cfg.vocab_size, (16, 16))}
+    config = {"train_batch_size": 16,
+              "fp16": {"enabled": True, "initial_scale_power": 8},
+              "optimizer": {"type": "OnebitAdam",
+                            "params": {"lr": 3e-3, "freeze_step": 3,
+                                       "comm_backend_name": "compressed"}}}
+    engine, *_ = ds.initialize(model=model, config=config,
+                               example_batch={k: v[:1] for k, v in batch.items()})
+    assert engine.fp16_enabled and engine._onebit_wire
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(10)]
+    assert losses[-1] < losses[0] - 1.0, losses
+    assert engine.loss_scale == 2.0 ** 8  # clean run: scale held
+
+    # crafted overflow IN THE COMPRESSED PHASE (freeze_step=0 so the very
+    # first step takes the compressed branch — an overflow during warmup
+    # never touches worker_error, which would make the feedback assertion
+    # vacuous): the step must SKIP (params unchanged, error feedback
+    # provably untouched by the NaN-laden discarded branch) and halve the
+    # scale
+    config_ov = {"train_batch_size": 16,
+                 "fp16": {"enabled": True, "initial_scale_power": 40,
+                          "hysteresis": 1},
+                 "optimizer": {"type": "OnebitAdam",
+                               "params": {"lr": 3e-3, "freeze_step": 0,
+                                          "comm_backend_name": "compressed"}}}
+    e2, *_ = ds.initialize(model=model, config=config_ov,
+                           example_batch={k: v[:1] for k, v in batch.items()})
+    p_before = jax.device_get(e2.state.params)
+    e2.train_batch(batch=batch)
+    assert int(jax.device_get(e2.state.skipped_steps)) >= 1
+    assert e2.loss_scale < 2.0 ** 40
+    werr = np.asarray(jax.device_get(e2.state.opt_state.worker_error))
+    assert not np.any(werr)  # error feedback untouched by the skipped step
+    p_after = jax.device_get(e2.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(p_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -63,8 +63,11 @@ def main():
 
     d = args.dir or tempfile.mkdtemp(prefix="ds_aio_bench_")
     points = []
-    blocks = [1 << 20] if args.tiny else [256 << 10, 1 << 20, 8 << 20]
-    threads = [2] if args.tiny else [1, 4, 8]
+    # r4: widened past the r3 sweep (best sat at its 8 MiB / 8-thread edge —
+    # the thread-pool design's queue depth IS the thread count, so deeper
+    # parallelism and bigger blocks are the remaining levers)
+    blocks = [1 << 20] if args.tiny else [1 << 20, 8 << 20, 32 << 20]
+    threads = [2] if args.tiny else [1, 4, 8, 16]
     for bs in blocks:
         for nt in threads:
             for direct in (False, True):

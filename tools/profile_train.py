@@ -33,9 +33,16 @@ def flops_fwd(n_params, batch, seq, n_layer, hidden):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke: tiny shapes, proves the artifact "
+                         "pipeline between chip windows")
     args = ap.parse_args()
 
     import jax
+
+    if args.tiny or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize pre-imports jax; env alone cannot switch platforms
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import optax
 
@@ -45,12 +52,24 @@ def main():
 
     def run_cfg(tag, remat, attention_impl, B, T, remat_policy="nothing",
                 vocab=32000, fbq=512, fbk=512):
-        cfg = LlamaConfig(vocab_size=vocab, hidden_size=1024, intermediate_size=2816,
-                          num_hidden_layers=24, num_attention_heads=16,
-                          num_key_value_heads=16, max_position_embeddings=max(T, 1024),
-                          remat=remat, attention_impl=attention_impl,
-                          remat_policy=remat_policy,
-                          flash_block_q=fbq, flash_block_k=fbk)
+        if args.tiny:
+            B, T, vocab = 2, 64, 256
+            cfg = LlamaConfig(vocab_size=vocab, hidden_size=64,
+                              intermediate_size=128, num_hidden_layers=2,
+                              num_attention_heads=4, num_key_value_heads=4,
+                              max_position_embeddings=max(T, 128),
+                              remat=remat, attention_impl=attention_impl,
+                              remat_policy=remat_policy,
+                              flash_block_q=fbq, flash_block_k=fbk)
+        else:
+            cfg = LlamaConfig(vocab_size=vocab, hidden_size=1024,
+                              intermediate_size=2816,
+                              num_hidden_layers=24, num_attention_heads=16,
+                              num_key_value_heads=16,
+                              max_position_embeddings=max(T, 1024),
+                              remat=remat, attention_impl=attention_impl,
+                              remat_policy=remat_policy,
+                              flash_block_q=fbq, flash_block_k=fbk)
         model = LlamaForCausalLM(cfg)
         ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)))
         params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)["params"]
