@@ -203,9 +203,14 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
       engine's ``value_and_grad`` receives exact grads without AD ever
       seeing the time scan.
 
-    Restrictions: the ``model``/``seq`` auto-axis composition of the
-    fill-drain path is not yet supported here (manual grads + auto axes
-    need per-axis psum bookkeeping); the engine rejects the combination.
+    TP composes like the fill-drain path: the ``model`` axis stays AUTO —
+    stage params keep their TP sharding and the partitioner inserts the
+    row-parallel psums inside each tick's vjp. The per-stage lax.conds are
+    safe under that: a TP group lives entirely inside one pipe stage, so
+    the branch predicate is uniform across every device that would meet in
+    a partitioner-inserted collective. ``seq`` (Ulysses resharding inside
+    the stage body) stays rejected here — its sharding constraints assume
+    the fill-drain grid.
     """
     S = pipe_module.num_stages
     M = num_microbatches
@@ -214,11 +219,12 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
     fwd_ring = [(i, (i + 1) % S) for i in range(S)]
     bwd_ring = [(i, (i - 1) % S) for i in range(S)]
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if shape.get("model", 1) != 1 or shape.get("seq", 1) != 1:
+    if shape.get("seq", 1) != 1:
         raise ValueError("pipeline.schedule='1f1b' does not compose with "
-                         "model/seq auto axes yet; use the default "
-                         "fill-drain schedule for pipe x TP / pipe x SP")
-    manual_axes = tuple(mesh.axis_names)
+                         "the seq auto axis yet; use the default "
+                         "fill-drain schedule for pipe x SP")
+    manual_axes = tuple(a for a in mesh.axis_names
+                        if a != "model" or shape.get(a, 1) == 1)
     replicas = int(np.prod([shape.get(a, 1) for a in manual_axes
                             if a != "pipe"]))
     replica_axes = tuple(a for a in manual_axes if a != "pipe")

@@ -104,6 +104,24 @@ class ZeroInfinityEngine:
             raise ValueError("ZeroInfinityEngine requires "
                              "zero_optimization.offload_param")
         self.block_layers = int(pcfg.block_layers)
+        # offload_param.device == "nvme": the streamed bf16 BODY lives in
+        # MEMORY-MAPPED files (the reference's partitioned_param_swapper
+        # pattern, stage3.py:465 + NVMe); the prefetch thread's reads pull
+        # pages through the OS cache and the in-place writeback dirties the
+        # same pages back to disk. NOTE the host optimizer's fp32 masters
+        # remain host-RAM (its nvme mode spills the MOMENT banks only), so
+        # this bounds the bf16 working copy by disk, not the whole state.
+        dev = str(getattr(pcfg.device, "value", pcfg.device))
+        self._nvme_dir = None
+        if dev == "nvme":
+            if pcfg.nvme_path:
+                self._nvme_dir = pcfg.nvme_path
+            else:
+                import tempfile
+
+                # a fixed shared default would let two engines open the same
+                # block files with mode w+ and silently clobber each other
+                self._nvme_dir = tempfile.mkdtemp(prefix="ds_param_swap_")
         self.global_steps = 0
         self.prefetch = True
         self.loss_scale = 1.0
@@ -188,12 +206,17 @@ class ZeroInfinityEngine:
             {k: v for k, v in prefix_tied.items() if v})
         #: the streamed body: persistent PRE-STACKED host bf16 staging,
         #: one pytree per block with ``[block_layers, ...]`` leaves
-        self.host_blocks: List[Any] = []
+        #: (memory-mapped files under nvme_path when device == "nvme")
+        blocks = []
         for b in range(self.n_blocks):
             layers = body_host[b * self.block_layers:(b + 1) * self.block_layers]
-            self.host_blocks.append(
+            blocks.append(
                 jax.tree_util.tree_map(lambda *ls: np.stack(ls), *layers))
         del body_host
+        # dp>1: placement happens in _rewire_dp_staging (the flat shard
+        # buffers are the real store; host_blocks become views of them)
+        self.host_blocks = blocks if self.dp > 1 \
+            else self._place_blocks(blocks)
 
         if self.dp > 1:
             self._init_dp_sharding()
@@ -239,13 +262,36 @@ class ZeroInfinityEngine:
 
     @host_body.setter
     def host_body(self, layers: List[Any]):
-        self.host_blocks = []
+        blocks = []
         for b in range(self.n_blocks):
             ls = layers[b * self.block_layers:(b + 1) * self.block_layers]
-            self.host_blocks.append(
+            blocks.append(
                 jax.tree_util.tree_map(lambda *xs: np.stack(xs), *ls))
         if self.dp > 1:
+            self.host_blocks = blocks
             self._rewire_dp_staging()
+        else:
+            self.host_blocks = self._place_blocks(blocks)
+
+    def _place_blocks(self, blocks: List[Any]) -> List[Any]:
+        """RAM (default) or NVMe memmap placement of the stacked blocks."""
+        if self._nvme_dir is None:
+            return blocks
+        import os
+
+        os.makedirs(self._nvme_dir, exist_ok=True)
+        placed = []
+        for b, blk in enumerate(blocks):
+            leaves, treedef = jax.tree_util.tree_flatten(blk)
+            mm = []
+            for i, leaf in enumerate(leaves):
+                path = os.path.join(self._nvme_dir, f"block{b}_leaf{i}.bin")
+                m = np.memmap(path, dtype=leaf.dtype, mode="w+",
+                              shape=leaf.shape)
+                m[...] = leaf
+                mm.append(m)
+            placed.append(jax.tree_util.tree_unflatten(treedef, mm))
+        return placed
 
     def _host_bytes(self) -> int:
         return sum(int(a.nbytes) for blk in self.host_blocks
@@ -269,19 +315,29 @@ class ZeroInfinityEngine:
         self._rewire_dp_staging()
         self.edge_params = jax.device_put(self.edge_params, self._repl)
 
+    def _alloc_flat(self, b: int, i: int, size: int, dtype) -> np.ndarray:
+        if self._nvme_dir is None:
+            return np.zeros(size, dtype=dtype)
+        import os
+
+        os.makedirs(self._nvme_dir, exist_ok=True)
+        path = os.path.join(self._nvme_dir, f"flat_block{b}_leaf{i}.bin")
+        return np.memmap(path, dtype=dtype, mode="w+", shape=(size,))
+
     def _rewire_dp_staging(self):
-        """Move the block store into padded flat staging buffers and turn
+        """Move the block store into padded flat staging buffers (RAM, or
+        NVMe memmaps under ``offload_param.device == "nvme"``) and turn
         ``host_blocks``' leaves into reshaped VIEWS of them — one host copy
         of the body, shared between the per-layer API and the per-shard
         ``device_put`` path (writebacks through either alias the other)."""
         self._flat_blocks: List[List[np.ndarray]] = []
         new_blocks = []
-        for blk in self.host_blocks:
+        for b, blk in enumerate(self.host_blocks):
             flats, views = [], []
-            for leaf, n, c, s in zip(jax.tree_util.tree_leaves(blk),
-                                     self._leaf_sizes, self._leaf_chunks,
-                                     self._leaf_shapes):
-                buf = np.zeros(self.dp * c, dtype=leaf.dtype)
+            for i, (leaf, n, c, s) in enumerate(zip(
+                    jax.tree_util.tree_leaves(blk), self._leaf_sizes,
+                    self._leaf_chunks, self._leaf_shapes)):
+                buf = self._alloc_flat(b, i, self.dp * c, leaf.dtype)
                 buf[:n] = np.ravel(leaf)
                 flats.append(buf)
                 views.append(buf[:n].reshape(s))
@@ -327,7 +383,10 @@ class ZeroInfinityEngine:
     def __del__(self):
         pool = getattr(self, "_xfer_pool", None)
         if pool is not None:
-            pool.shutdown(wait=False, cancel_futures=True)
+            try:
+                pool.shutdown(wait=False, cancel_futures=True)
+            except Exception:  # interpreter teardown: queue module may be gone
+                pass
 
     def _fetch(self, b: int, prefetch: bool):
         """Issue block b's transfer on the dedicated thread (overlaps the
@@ -577,11 +636,17 @@ class ZeroInfinityEngine:
             else jnp.asarray(a), full["edges"])
         self.edge_params = jax.device_put(edges, self._repl) \
             if self.dp > 1 else edges
-        self.host_blocks = [jax.tree_util.tree_map(
+        restored = [jax.tree_util.tree_map(
             lambda a: np.asarray(a).astype(ml_dtypes.bfloat16), blk)
             for blk in full["body"]]
         if self.dp > 1:
+            # placement happens in the rewire (flat buffers are the store);
+            # routing through _place_blocks first would write a stale extra
+            # copy of the body to disk under nvme
+            self.host_blocks = restored
             self._rewire_dp_staging()
+        else:
+            self.host_blocks = self._place_blocks(restored)
         self.global_steps = int(z["global_steps"])
         return load_dir, {"global_steps": self.global_steps}
 

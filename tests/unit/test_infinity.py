@@ -275,10 +275,74 @@ class TestInfinity:
         engine.train_batch(_batch())
         assert engine._host_opt.current_lr() > lr0  # warming up
 
+    def test_nvme_body_memmap_streams_and_roundtrips(self, tmp_path):
+        """``offload_param.device == "nvme"`` (r4): the streamed BODY lives
+        in memory-mapped files — model size bounded by disk, the reference
+        partitioned_param_swapper capability (stage3.py:465 + NVMe). The
+        in-place optimizer writeback must land in the files, and a
+        checkpoint restore must re-place onto the maps."""
+        import os
+
+        swap = tmp_path / "pswap"
+        engine, *_ = ds.initialize(
+            model=_module(layers=4),
+            config=_cfg(block_layers=2, device="nvme",
+                        nvme_path=str(swap)),
+            example_batch=_batch(), rng=jax.random.PRNGKey(4))
+        files = os.listdir(swap)
+        assert any(f.startswith("block") for f in files), files
+        leaf0 = jax.tree_util.tree_leaves(engine.host_blocks[0])[0]
+        assert isinstance(leaf0, np.memmap)
+        before = np.array(leaf0, np.float32, copy=True)
+        b = _batch()
+        losses = [float(engine.train_batch(b)) for _ in range(6)]
+        assert losses[-1] < losses[0] - 0.3, losses
+        after = np.asarray(
+            jax.tree_util.tree_leaves(engine.host_blocks[0])[0], np.float32)
+        assert np.abs(after - before).max() > 0  # writeback hit the map
+
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        fresh, *_ = ds.initialize(
+            model=_module(layers=4),
+            config=_cfg(block_layers=2, device="nvme",
+                        nvme_path=str(tmp_path / "pswap2")),
+            example_batch=_batch(), rng=jax.random.PRNGKey(99))
+        fresh.load_checkpoint(str(tmp_path / "ck"))
+        assert isinstance(
+            jax.tree_util.tree_leaves(fresh.host_blocks[0])[0], np.memmap)
+        for got, ref in zip(fresh.host_body, engine.host_body):
+            jax.tree_util.tree_map(
+                lambda a, r: np.testing.assert_array_equal(
+                    np.asarray(a, np.float32), np.asarray(r, np.float32)),
+                got, ref)
+
+    def test_nvme_body_composes_with_dp(self, tmp_path):
+        """nvme body x dp: the FLAT shard staging itself is memmap-backed
+        (host_blocks are views of the maps), so dp sharding does not pull
+        the body back into RAM."""
+        import os
+
+        import jax.sharding as shd
+
+        mesh = shd.Mesh(np.array(jax.devices()[:2]), ("data",))
+        swap = tmp_path / "pswap_dp"
+        engine, *_ = ds.initialize(
+            model=_module(layers=4),
+            config=_cfg(block_layers=2, device="nvme", nvme_path=str(swap)),
+            example_batch=_batch(), rng=jax.random.PRNGKey(5), mesh=mesh)
+        assert engine.dp == 2
+        assert any(f.startswith("flat_block") for f in os.listdir(swap))
+        assert isinstance(engine._flat_blocks[0][0], np.memmap)
+        b = _batch()
+        losses = [float(engine.train_batch(b)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+
     def test_nvme_moments_compose(self, tmp_path):
-        """offload_param (streamed weights) + offload_optimizer nvme
-        (spilled moments): the full ZeRO-Infinity working set."""
-        cfg = _cfg(block_layers=2)
+        """offload_param nvme BODY + offload_optimizer nvme MOMENTS: the
+        full ZeRO-Infinity disk-resident working set (params + optimizer
+        state both bounded by NVMe, reference 40B-on-one-V100 class)."""
+        cfg = _cfg(block_layers=2, device="nvme",
+                   nvme_path=str(tmp_path / "body"))
         cfg["zero_optimization"]["offload_optimizer"] = {
             "device": "nvme", "nvme_path": str(tmp_path)}
         engine, *_ = ds.initialize(model=_module(layers=4), config=cfg,
