@@ -6,3 +6,4 @@ from .partition import (  # noqa: F401
     shard_params,
     state_shardings,
 )
+from .tiling import TiledLinear, split_tensor_along_last_dim  # noqa: F401
