@@ -40,8 +40,11 @@ hooks; here the model is a LAYER LIST (``PipelineModule`` with
   (``HostOffloadOptimizer``: SIMD cpu_adam, NVMe moment spill), then new
   bf16 weights are written IN PLACE into the persistent staging blocks.
 
-Enable via ``zero_optimization.offload_param: {"device": "cpu"}`` with a
-``PipelineModule`` model; ``deepspeed_tpu.initialize`` dispatches here.
+Enable via ``zero_optimization.offload_param: {"device": "cpu"|"nvme"}``
+with a ``PipelineModule`` model; ``deepspeed_tpu.initialize`` dispatches
+here. ``"nvme"`` puts the streamed body in memory-mapped files, and adding
+``offload_optimizer: {"device": "nvme"}`` (full-NVMe mode) spills the fp32
+masters and per-step grad buffers too — every O(model) array disk-backed.
 """
 
 import time
@@ -108,9 +111,11 @@ class ZeroInfinityEngine:
         # MEMORY-MAPPED files (the reference's partitioned_param_swapper
         # pattern, stage3.py:465 + NVMe); the prefetch thread's reads pull
         # pages through the OS cache and the in-place writeback dirties the
-        # same pages back to disk. NOTE the host optimizer's fp32 masters
-        # remain host-RAM (its nvme mode spills the MOMENT banks only), so
-        # this bounds the bf16 working copy by disk, not the whole state.
+        # same pages back to disk. Combined with offload_optimizer nvme
+        # (full-NVMe mode, set below): fp32 masters spill to memmaps, the
+        # per-step grad buffers are memmap-backed, and the optimizer
+        # writeback streams leaf-at-a-time — every O(model) array is
+        # disk-resident.
         dev = str(getattr(pcfg.device, "value", pcfg.device))
         self._nvme_dir = None
         if dev == "nvme":
@@ -222,11 +227,25 @@ class ZeroInfinityEngine:
             self._init_dp_sharding()
 
         # ---- host optimizer over the FULL fp32 state -------------------
+        # full-NVMe mode (body nvme + offload_optimizer nvme): fp32 masters
+        # spill to memmaps and per-step gradients land in persistent memmap
+        # buffers too, so EVERY O(model) array — bf16 body, fp32 masters,
+        # moments, grads — is disk-backed; host RAM holds page cache plus
+        # O(block) transients (the reference's full ZeRO-Infinity shape)
+        ocfg = zcfg.offload_optimizer
+        odev = str(getattr(getattr(ocfg, "device", None), "value",
+                           getattr(ocfg, "device", None)))
+        self._full_nvme = self._nvme_dir is not None and odev == "nvme"
         full = {"edges": jax.tree_util.tree_map(
                     lambda a: np.asarray(a, np.float32), self.edge_params),
                 "body": [jax.tree_util.tree_map(
                     lambda a: np.asarray(a, np.float32), blk)
                     for blk in self.host_blocks]}
+        #: host staging for edge writebacks (tiny; device_put after step)
+        self._edges_staging = jax.tree_util.tree_map(
+            lambda a: np.array(np.asarray(jax.device_get(a))),
+            self.edge_params)
+        self._grad_blocks: Optional[List[Any]] = None
         sched_cfg = self._config.scheduler
         if lr_scheduler is None and sched_cfg is not None \
                 and sched_cfg.type is not None:
@@ -234,12 +253,16 @@ class ZeroInfinityEngine:
 
             lr_scheduler = get_lr_schedule(sched_cfg.type, sched_cfg.params)
         self.lr_scheduler = lr_scheduler
+        import os as _os
+
         self._host_opt = HostOffloadOptimizer(
             full, opt_cfg.type if opt_cfg else "AdamW",
             dict(opt_cfg.params or {}) if opt_cfg else {},
             zcfg.offload_optimizer,
             gradient_clipping=self._config.gradient_clipping,
-            lr_scheduler=lr_scheduler)
+            lr_scheduler=lr_scheduler,
+            spill_masters_dir=_os.path.join(self._nvme_dir, "masters")
+            if self._full_nvme else None)
 
         self._build_jits()
         log_dist(f"ZeRO-Infinity: {self.L} body layers on host "
@@ -277,19 +300,14 @@ class ZeroInfinityEngine:
         """RAM (default) or NVMe memmap placement of the stacked blocks."""
         if self._nvme_dir is None:
             return blocks
-        import os
+        from .offload import memmap_alloc
 
-        os.makedirs(self._nvme_dir, exist_ok=True)
         placed = []
         for b, blk in enumerate(blocks):
             leaves, treedef = jax.tree_util.tree_flatten(blk)
-            mm = []
-            for i, leaf in enumerate(leaves):
-                path = os.path.join(self._nvme_dir, f"block{b}_leaf{i}.bin")
-                m = np.memmap(path, dtype=leaf.dtype, mode="w+",
-                              shape=leaf.shape)
-                m[...] = leaf
-                mm.append(m)
+            mm = [memmap_alloc(self._nvme_dir, f"block{b}_leaf{i}.bin",
+                               leaf.dtype, leaf.shape, init=leaf)
+                  for i, leaf in enumerate(leaves)]
             placed.append(jax.tree_util.tree_unflatten(treedef, mm))
         return placed
 
@@ -318,11 +336,10 @@ class ZeroInfinityEngine:
     def _alloc_flat(self, b: int, i: int, size: int, dtype) -> np.ndarray:
         if self._nvme_dir is None:
             return np.zeros(size, dtype=dtype)
-        import os
+        from .offload import memmap_alloc
 
-        os.makedirs(self._nvme_dir, exist_ok=True)
-        path = os.path.join(self._nvme_dir, f"flat_block{b}_leaf{i}.bin")
-        return np.memmap(path, dtype=dtype, mode="w+", shape=(size,))
+        return memmap_alloc(self._nvme_dir, f"flat_block{b}_leaf{i}.bin",
+                            dtype, (size,))
 
     def _rewire_dp_staging(self):
         """Move the block store into padded flat staging buffers (RAM, or
@@ -447,15 +464,44 @@ class ZeroInfinityEngine:
 
     # ------------------------------------------------------------------
 
-    def _grads_to_host_block(self, g_bp) -> Any:
-        """Device block-grads → host fp32 stacked tree ``[k, ...]``."""
+    def _grad_target_blocks(self) -> List[Any]:
+        """Persistent per-step gradient buffers mirroring ``host_blocks``
+        (full-NVMe: fp32 memmaps, so grads never occupy O(model) RAM)."""
+        if self._grad_blocks is None:
+            from .offload import memmap_alloc
+
+            bufs = []
+            for b, blk in enumerate(self.host_blocks):
+                leaves, treedef = jax.tree_util.tree_flatten(blk)
+                gl = []
+                for i, leaf in enumerate(leaves):
+                    if self._full_nvme:
+                        gl.append(memmap_alloc(
+                            self._nvme_dir, f"grad_block{b}_leaf{i}.bin",
+                            np.float32, leaf.shape))
+                    else:
+                        gl.append(np.zeros(leaf.shape, np.float32))
+                bufs.append(jax.tree_util.tree_unflatten(treedef, gl))
+            self._grad_blocks = bufs
+        return self._grad_blocks
+
+    def _grads_to_host_block(self, b: int, g_bp, accumulate: bool) -> Any:
+        """Device block-grads → the persistent host fp32 buffer for block b
+        (``[k, ...]`` leaves; += under gradient accumulation)."""
+        target = self._grad_target_blocks()[b]
         if self.dp == 1:
-            return jax.tree_util.tree_map(
-                lambda a: np.asarray(jax.device_get(a), np.float32), g_bp)
-        leaves = [np.asarray(jax.device_get(f), np.float32)[:n].reshape(s)
-                  for f, n, s in zip(g_bp, self._leaf_sizes,
-                                     self._leaf_shapes)]
-        return jax.tree_util.tree_unflatten(self._block_treedef, leaves)
+            fresh = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a), np.float32), g_bp))
+        else:
+            fresh = [np.asarray(jax.device_get(f), np.float32)[:n].reshape(s)
+                     for f, n, s in zip(g_bp, self._leaf_sizes,
+                                        self._leaf_shapes)]
+        for dst, src in zip(jax.tree_util.tree_leaves(target), fresh):
+            if accumulate:
+                np.add(dst, src, out=dst)
+            else:
+                np.copyto(dst, src)
+        return target
 
     def _mark(self):
         if self.track_device_memory:
@@ -464,9 +510,10 @@ class ZeroInfinityEngine:
             self.last_peak_device_bytes = max(
                 self.last_peak_device_bytes, live)
 
-    def _micro_grads(self, x, labels):
+    def _micro_grads(self, x, labels, accumulate: bool = False):
         """One micro-batch: streamed forward + reverse-streamed backward.
-        Returns (loss, host fp32 grads {'edges', 'body': [blocked trees]})."""
+        Returns (loss, host fp32 grads {'edges', 'body': [blocked trees]});
+        body grads land in the persistent buffers (+= when accumulating)."""
         # ---- forward: stream blocks with 1-deep threaded prefetch -------
         h = self._j_prefix(self.edge_params, x)
         boundaries = [h]
@@ -491,7 +538,8 @@ class ZeroInfinityEngine:
             fut = self._fetch(b - 1, self.prefetch) if b > 0 else None
             g_bp, g_h = self._j_block_vjp(cur, boundaries[b], g_h)
             self._mark()
-            body_grads_host[b] = self._grads_to_host_block(g_bp)
+            body_grads_host[b] = self._grads_to_host_block(b, g_bp,
+                                                           accumulate)
             del g_bp
             cur = self._resolve(fut, self, b - 1) if b > 0 else None
         g_edges_prefix = self._j_prefix_grad(self.edge_params, x, g_h)
@@ -538,43 +586,57 @@ class ZeroInfinityEngine:
             a = jnp.asarray(a)
             return jax.device_put(a, self._shard_batch) if self.dp > 1 else a
 
-        grads = None
+        grads_edges = None
+        grads_body = None
         loss_sum = 0.0
         t_stream = time.perf_counter()
-        for x_np, y_np in micros:
-            loss, micro = self._micro_grads(put(x_np), put(y_np))
+        for g, (x_np, y_np) in enumerate(micros):
+            loss, micro = self._micro_grads(put(x_np), put(y_np),
+                                            accumulate=g > 0)
             loss_sum += float(loss)
-            if grads is None:
-                grads = micro
+            grads_body = micro["body"]  # persistent buffers; += in place
+            if grads_edges is None:
+                grads_edges = micro["edges"]
             else:
-                grads = jax.tree_util.tree_map(np.add, grads, micro)
+                grads_edges = jax.tree_util.tree_map(np.add, grads_edges,
+                                                     micro["edges"])
         #: streaming phase (block H2D + compute + grad D2H) — the part the
         #: threaded prefetch overlaps; the host optimizer step is separate
         self._last_stream_s = time.perf_counter() - t_stream
         if self.gas > 1:
-            grads = jax.tree_util.tree_map(
-                lambda a: a / self.gas, grads)
+            grads_edges = jax.tree_util.tree_map(
+                lambda a: a / self.gas, grads_edges)
+            for blk in grads_body:
+                for leaf in jax.tree_util.tree_leaves(blk):
+                    np.divide(leaf, self.gas, out=leaf)
+        grads = {"edges": grads_edges, "body": grads_body}
         loss = loss_sum / self.gas if self.gas > 1 else loss
 
         # ---- host optimizer step + in-place writeback ------------------
-        new_params, overflow, self._last_grad_norm = self._host_opt.step(
-            grads, loss_scale=self.loss_scale)
-        if not overflow:
-            import ml_dtypes
+        # targets in the optimizer's leaf order ({"body", "edges"} flatten):
+        # body leaves alias the persistent staging (dp>1: flat-buffer
+        # views; nvme: memmaps), edges go through tiny host staging
+        wb_targets = jax.tree_util.tree_leaves(
+            {"body": self.host_blocks, "edges": self._edges_staging})
 
+        def writeback(li, master_view):
+            np.copyto(wb_targets[li], master_view, casting="unsafe")
+
+        _, overflow, self._last_grad_norm = self._host_opt.step(
+            grads, loss_scale=self.loss_scale, writeback=writeback)
+        if not self._full_nvme:
+            # RAM mode: the grad buffers are per-STEP scratch — holding them
+            # between steps would pin a permanent fp32 model copy that the
+            # RAM-bounded sizing never budgeted for (full-NVMe keeps its
+            # memmaps: they're disk pages, and reopening per step is churn)
+            self._grad_blocks = None
+        if not overflow:
             edges = jax.tree_util.tree_map(
                 lambda a: jnp.asarray(a, jnp.bfloat16)
                 if np.issubdtype(np.asarray(a).dtype, np.floating)
-                else jnp.asarray(a), new_params["edges"])
+                else jnp.asarray(a), self._edges_staging)
             self.edge_params = jax.device_put(edges, self._repl) \
                 if self.dp > 1 else edges
-            # in-place into the persistent staging (dp>1: the leaves are
-            # views of the flat shard buffers, so this write lands there too)
-            for blk_dst, blk_new in zip(self.host_blocks,
-                                        new_params["body"]):
-                jax.tree_util.tree_map(
-                    lambda dst, src: np.copyto(dst, src, casting="unsafe"),
-                    blk_dst, blk_new)
         self.global_steps += 1
         self._last_step_s = time.perf_counter() - t0
         return loss

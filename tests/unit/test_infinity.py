@@ -337,6 +337,50 @@ class TestInfinity:
         losses = [float(engine.train_batch(b)) for _ in range(4)]
         assert losses[-1] < losses[0], losses
 
+    def test_full_nvme_masters_and_grads_disk_backed(self, tmp_path):
+        """Full ZeRO-Infinity disk residency (r4): with body nvme +
+        offload_optimizer nvme, EVERY O(model) array is disk-backed — bf16
+        body (memmap), fp32 masters (memmap), moments (aio spill), and the
+        per-step gradient buffers (memmap). Training converges and the
+        checkpoint round-trips through the spilled state."""
+        import os
+
+        cfg = _cfg(block_layers=2, device="nvme",
+                   nvme_path=str(tmp_path / "body"))
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(tmp_path / "moments")}
+        engine, *_ = ds.initialize(model=_module(layers=4), config=cfg,
+                                   example_batch=_batch(),
+                                   rng=jax.random.PRNGKey(7))
+        assert engine._full_nvme
+        # the SIMD optimizer may rewrap the master as a base-class VIEW of
+        # the memmap; the mapped pages are what matters
+        m0 = engine._host_opt.master[0]
+        assert isinstance(m0, np.memmap) or \
+            isinstance(getattr(m0, "base", None), np.memmap), type(m0)
+        b = _batch()
+        losses = [float(engine.train_batch(b)) for _ in range(5)]
+        assert losses[-1] < losses[0] - 0.3, losses
+        body_dir = os.listdir(tmp_path / "body")
+        assert any(f.startswith("grad_block") for f in body_dir)
+        assert any(f.startswith("master_") for f in
+                   os.listdir(tmp_path / "body" / "masters"))
+        assert isinstance(
+            jax.tree_util.tree_leaves(engine._grad_blocks[0])[0], np.memmap)
+
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        cfg2 = _cfg(block_layers=2, device="nvme",
+                    nvme_path=str(tmp_path / "body2"))
+        cfg2["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(tmp_path / "moments2")}
+        fresh, *_ = ds.initialize(model=_module(layers=4), config=cfg2,
+                                  example_batch=_batch(),
+                                  rng=jax.random.PRNGKey(99))
+        fresh.load_checkpoint(str(tmp_path / "ck"))
+        la = float(engine.train_batch(_batch(seed=3)))
+        lb = float(fresh.train_batch(_batch(seed=3)))
+        assert abs(la - lb) < 1e-3
+
     def test_nvme_moments_compose(self, tmp_path):
         """offload_param nvme BODY + offload_optimizer nvme MOMENTS: the
         full ZeRO-Infinity disk-resident working set (params + optimizer
