@@ -180,8 +180,11 @@ def main():
     budget = float(os.environ.get("DS_BENCH_BUDGET_S",
                                   "360" if tiny else "1500"))
     probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
+    # tiny cap carries headroom over the ~95s quiet-machine candidate time:
+    # a loaded CI host (the slow tier runs benches alongside) doubled it
+    # past the old 120s cap and produced value=null flakes
     cand_cap = float(os.environ.get("DS_BENCH_CANDIDATE_S",
-                                    "120" if tiny else "420"))
+                                    "170" if tiny else "420"))
     t_start = time.time()
 
     # 1) fail-fast device probe (skipped in tiny/CPU smoke mode)

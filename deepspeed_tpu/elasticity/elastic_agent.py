@@ -158,6 +158,21 @@ class ElasticAgent:
         latest = os.path.join(self.checkpoint_dir, "latest")
         if not os.path.exists(latest):
             return None
+        try:
+            with open(latest) as f:
+                tag = f.read().strip()
+        except OSError:
+            tag = ""
+        if tag and os.path.exists(os.path.join(self.checkpoint_dir,
+                                               f"{tag}.infinity.npz")):
+            # ZeRO-Infinity host checkpoints are already topology-agnostic
+            # (fp32 masters npz, no mesh); the respawned workers auto-resume
+            # them directly — running the orbax converter here would just
+            # burn two failing subprocesses and log a bogus "from scratch"
+            print(f"elastic-agent: {tag} is a ZeRO-Infinity host checkpoint "
+                  "(topology-free); skipping universal conversion",
+                  file=sys.stderr)
+            return self.checkpoint_dir
         out = os.path.join(self.checkpoint_dir, UNIVERSAL_SUBDIR)
         tmp = out + ".tmp"
         shutil.rmtree(tmp, ignore_errors=True)

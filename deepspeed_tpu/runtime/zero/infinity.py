@@ -265,6 +265,21 @@ class ZeroInfinityEngine:
             if self._full_nvme else None)
 
         self._build_jits()
+
+        # ---- elastic-agent contract (elasticity/elastic_agent.py) ------
+        # the Infinity checkpoint is host-side fp32/bf16 npz with no mesh
+        # in it — already topology-agnostic, so auto-resume reads the
+        # LATEST engine save directly (no universal conversion needed; the
+        # agent's converter is a no-op for this engine class)
+        self._elastic_ckpt_dir = _os.environ.get(
+            "DS_ELASTIC_CHECKPOINT_DIR")
+        if self._elastic_ckpt_dir and _os.path.exists(
+                _os.path.join(self._elastic_ckpt_dir, "latest")):
+            self.load_checkpoint(self._elastic_ckpt_dir)
+            log_dist(f"ZeRO-Infinity elastic auto-resume from "
+                     f"{self._elastic_ckpt_dir} at step {self.global_steps}",
+                     ranks=[0])
+
         log_dist(f"ZeRO-Infinity: {self.L} body layers on host "
                  f"({self._host_bytes() / 1e6:.1f} MB bf16), streamed in "
                  f"{self.n_blocks} blocks of {self.block_layers}; device "
@@ -638,8 +653,31 @@ class ZeroInfinityEngine:
             self.edge_params = jax.device_put(edges, self._repl) \
                 if self.dp > 1 else edges
         self.global_steps += 1
+        if self._elastic_ckpt_dir and jax.process_index() == 0 and \
+                self.global_steps % max(
+                    1, self._config.elasticity.save_interval) == 0:
+            self.save_checkpoint(self._elastic_ckpt_dir)
+            self._prune_elastic_checkpoints(keep=2)
         self._last_step_s = time.perf_counter() - t0
         return loss
+
+    def _prune_elastic_checkpoints(self, keep: int) -> None:
+        """The masters make each save O(model fp32) on disk — keep only the
+        newest ``keep`` snapshots in the agent dir."""
+        import os
+        import re
+
+        d = self._elastic_ckpt_dir
+        steps = []
+        for name in os.listdir(d):
+            m = re.fullmatch(r"global_step(\d+)\.infinity\.npz", name)
+            if m:
+                steps.append(int(m.group(1)))
+        for s in sorted(steps)[:-keep]:
+            try:
+                os.remove(os.path.join(d, f"global_step{s}.infinity.npz"))
+            except OSError:
+                pass
 
     # -- checkpointing ---------------------------------------------------
     # Host-side state (bf16 layer store + fp32 masters/moments) saved as
@@ -661,10 +699,18 @@ class ZeroInfinityEngine:
         for mi, bank in enumerate(sd["moments"]):
             for li, buf in enumerate(bank):
                 arrays[f"moment_{mi}_{li}"] = buf
-        np.savez(os.path.join(save_dir, f"{tag}.infinity.npz"), **arrays)
+        # atomic: a killed or concurrent writer must never leave a torn
+        # npz where "latest" points (elastic auto-resume np.loads it)
+        path = os.path.join(save_dir, f"{tag}.infinity.npz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
+            ltmp = os.path.join(save_dir, "latest.tmp")
+            with open(ltmp, "w") as f:
                 f.write(tag)
+            os.replace(ltmp, os.path.join(save_dir, "latest"))
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
