@@ -203,14 +203,17 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
       engine's ``value_and_grad`` receives exact grads without AD ever
       seeing the time scan.
 
-    TP composes like the fill-drain path: the ``model`` axis stays AUTO —
-    stage params keep their TP sharding and the partitioner inserts the
-    row-parallel psums inside each tick's vjp. The per-stage lax.conds are
-    safe under that: a TP group lives entirely inside one pipe stage, so
-    the branch predicate is uniform across every device that would meet in
-    a partitioner-inserted collective. ``seq`` (Ulysses resharding inside
-    the stage body) stays rejected here — its sharding constraints assume
-    the fill-drain grid.
+    TP and SP compose like the fill-drain path: the ``model`` and ``seq``
+    axes stay AUTO — stage params keep their TP sharding, Ulysses
+    attention reshards over ``seq`` via its constraints, and the
+    partitioner inserts the psums inside each tick's vjp. Everything that
+    can carry a partitioner-inserted collective (stage vjp, suffix grad,
+    prefix vjp) runs UNCONDITIONALLY on every stage with where-selected
+    cotangents — stage-branched lax.cond around such code deadlocks,
+    because the partitioner emits FULL-mesh-participation reshards inside
+    the branches while stages diverge on the predicate (observed on the
+    CPU mesh; same wedge on real chips). The one cond that remains (the
+    boundary-buffer update) is collective-free by construction.
     """
     S = pipe_module.num_stages
     M = num_microbatches
@@ -219,12 +222,8 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
     fwd_ring = [(i, (i + 1) % S) for i in range(S)]
     bwd_ring = [(i, (i - 1) % S) for i in range(S)]
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if shape.get("seq", 1) != 1:
-        raise ValueError("pipeline.schedule='1f1b' does not compose with "
-                         "the seq auto axis yet; use the default "
-                         "fill-drain schedule for pipe x SP")
     manual_axes = tuple(a for a in mesh.axis_names
-                        if a != "model" or shape.get(a, 1) == 1)
+                        if a not in ("model", "seq") or shape.get(a, 1) == 1)
     replicas = int(np.prod([shape.get(a, 1) for a in manual_axes
                             if a != "pipe"]))
     replica_axes = tuple(a for a in manual_axes if a != "pipe")
@@ -288,6 +287,15 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
             x_send = jax.lax.ppermute(y, "pipe", fwd_ring)
 
             # ---- B slot: backward microbatch b = t - (2S-2-stage) -------
+            # COLLECTIVE-UNIFORM by construction: the stage vjp, the
+            # suffix loss-grad, and the prefix vjp all run UNCONDITIONALLY
+            # on every stage and the cotangents are SELECTED with where.
+            # Branching on `stage` around them deadlocks: under auto
+            # TP/SP axes the partitioner places reshard collectives with
+            # FULL-mesh participation inside the branches, and stages
+            # diverge on the predicate (observed as a collective-permute
+            # rendezvous stuck across op ids on the CPU mesh; the same
+            # divergence would wedge real chips).
             b = t - (2 * S - 2 - stage)
             active_b = (b >= 0) & (b < M)
             bidx = jnp.clip(b, 0, M - 1)
@@ -296,48 +304,30 @@ def _pipeline_1f1b_loss_fn(pipe_module: PipelineModule, mesh,
             labels_b = jax.lax.dynamic_index_in_dim(labels, bidx, 0,
                                                     keepdims=False)
 
-            def last_stage_bwd(ops):
-                x_s, _g_in = ops
+            def stage_fwd(sp, x):
+                return pipe_module.apply_stage(sp, x, rng=rng_stage(bidx))
 
-                def fwd_loss(sp, e, x):
-                    yy = pipe_module.apply_stage(sp, x, rng=rng_stage(bidx))
-                    out = pipe_module.apply_suffix(e, yy,
-                                                   rng=rng_edge(bidx, 5))
-                    return pipe_module.loss_fn(out, labels_b).astype(
-                        jnp.float32)
+            y2, pull = jax.vjp(stage_fwd, stage_params, x_saved)
 
-                lossval, pull = jax.vjp(fwd_loss, stage_params, edges, x_s)
-                g_sp, g_e, g_x = pull(jnp.float32(1.0))
-                return lossval, g_sp, g_e, g_x
+            def loss_from_y(e, yy):
+                out = pipe_module.apply_suffix(e, yy, rng=rng_edge(bidx, 5))
+                return pipe_module.loss_fn(out, labels_b).astype(jnp.float32)
 
-            def mid_stage_bwd(ops):
-                x_s, g_in = ops
+            lossval, pull_loss = jax.vjp(loss_from_y, edges, y2)
+            g_e_suffix, g_y_loss = pull_loss(jnp.float32(1.0))
+            g_y = jnp.where(stage == S - 1, g_y_loss, g_recv)
+            g_sp, g_x = pull(g_y)
+            g_e = jax.tree_util.tree_map(
+                lambda a: jnp.where(stage == S - 1, a, 0.0), g_e_suffix)
+            lossval = jnp.where(stage == S - 1, lossval, 0.0)
 
-                def fwd(sp, x):
-                    return pipe_module.apply_stage(sp, x,
-                                                   rng=rng_stage(bidx))
+            def pf(e):
+                return prefix_at(e, bidx)
 
-                _, pull = jax.vjp(fwd, stage_params, x_s)
-                g_sp, g_x = pull(g_in)
-                zero_e = jax.tree_util.tree_map(jnp.zeros_like, edges)
-                return jnp.float32(0.0), g_sp, zero_e, g_x
-
-            lossval, g_sp, g_e, g_x = jax.lax.cond(
-                stage == S - 1, last_stage_bwd, mid_stage_bwd,
-                (x_saved, g_recv))
-
-            def add_prefix_grads(ops):
-                g_e_in, g_x_in = ops
-
-                def pf(e):
-                    return prefix_at(e, bidx)
-
-                _, pull = jax.vjp(pf, edges)
-                (g_pe,) = pull(g_x_in)
-                return jax.tree_util.tree_map(jnp.add, g_e_in, g_pe)
-
-            g_e = jax.lax.cond(stage == 0, add_prefix_grads,
-                               lambda ops: ops[0], (g_e, g_x))
+            _, pull_pf = jax.vjp(pf, edges)
+            (g_pe,) = pull_pf(g_x)
+            g_e = jax.tree_util.tree_map(
+                lambda a, p_: a + jnp.where(stage == 0, p_, 0.0), g_e, g_pe)
 
             mask = lambda g, acc: jax.tree_util.tree_map(
                 lambda a, gg: a + jnp.where(active_b,

@@ -273,12 +273,20 @@ class ZeroInfinityEngine:
         # agent's converter is a no-op for this engine class)
         self._elastic_ckpt_dir = _os.environ.get(
             "DS_ELASTIC_CHECKPOINT_DIR")
-        if self._elastic_ckpt_dir and _os.path.exists(
-                _os.path.join(self._elastic_ckpt_dir, "latest")):
-            self.load_checkpoint(self._elastic_ckpt_dir)
-            log_dist(f"ZeRO-Infinity elastic auto-resume from "
-                     f"{self._elastic_ckpt_dir} at step {self.global_steps}",
-                     ranks=[0])
+        if self._elastic_ckpt_dir:
+            latest = _os.path.join(self._elastic_ckpt_dir, "latest")
+            tag = ""
+            if _os.path.exists(latest):
+                with open(latest) as _f:
+                    tag = _f.read().strip()
+            # resume only an INFINITY npz: 'latest' alone may point at a
+            # plain-engine directory checkpoint from a previous job
+            if tag and _os.path.exists(_os.path.join(
+                    self._elastic_ckpt_dir, f"{tag}.infinity.npz")):
+                self.load_checkpoint(self._elastic_ckpt_dir, tag=tag)
+                log_dist(f"ZeRO-Infinity elastic auto-resume from "
+                         f"{self._elastic_ckpt_dir} at step "
+                         f"{self.global_steps}", ranks=[0])
 
         log_dist(f"ZeRO-Infinity: {self.L} body layers on host "
                  f"({self._host_bytes() / 1e6:.1f} MB bf16), streamed in "
@@ -673,6 +681,13 @@ class ZeroInfinityEngine:
             m = re.fullmatch(r"global_step(\d+)\.infinity\.npz", name)
             if m:
                 steps.append(int(m.group(1)))
+            elif name.endswith(".infinity.npz.tmp"):
+                # a SIGKILLed save leaves an O(model-fp32) torn tmp behind;
+                # any tmp still present at the NEXT save is dead weight
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
         for s in sorted(steps)[:-keep]:
             try:
                 os.remove(os.path.join(d, f"global_step{s}.infinity.npz"))
