@@ -14,8 +14,12 @@ fused Adam/LAMB) against its XLA reference:
 
 Prints ONE JSON line; commit as ``KERNELS_r{N}.json``. Run via
 ``tools/chip_sweep.py`` or directly: ``python tools/bench_kernels.py``.
+``--only flash_fwd,decode`` restricts to named kernels (the r4 chip window
+showed the all-in-one run can exceed a subprocess cap without revealing
+which kernel stalled — per-kernel runs isolate that).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -50,7 +54,17 @@ def _record(name, mode, ref, got, t_pallas, t_xla, tol):
             "speedup_vs_xla": round(t_xla / t_pallas, 3) if t_pallas else None}
 
 
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma list of kernel names to run (default: all)")
+    args = ap.parse_args()
+    only = set(filter(None, args.only.split(",")))
+
     import jax
 
     # the sandbox pre-imports jax via sitecustomize, so JAX_PLATFORMS in the
@@ -70,11 +84,16 @@ def main():
     results = []
 
     def run(name, fn):
+        if only and name not in only:
+            return
+        _log(f"bench_kernels: {name} ...")
+        t0 = time.time()
         try:
             results.append(fn())
         except Exception as e:  # record the failure, keep sweeping
             results.append({"kernel": name, "mode": mode, "allclose": False,
                             "error": f"{type(e).__name__}: {str(e)[:300]}"})
+        _log(f"bench_kernels: {name} done in {time.time() - t0:.1f}s")
 
     # ---- flash attention fwd + bwd -----------------------------------
     from deepspeed_tpu.ops.pallas.flash_attention import (_reference_attention,

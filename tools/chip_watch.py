@@ -8,8 +8,11 @@ hanging past 300 s. This watcher runs from minute zero of the round:
     subprocess (a hung backend costs one subprocess, not the watcher)
   - every probe is appended to --log (default TPU_DOWN_<tag>.log) so a
     full-round outage leaves committed evidence, as in round 3
-  - the moment a probe succeeds it execs tools/chip_sweep.py --tag <tag>
-    and exits, leaving the sweep artifacts in the repo root
+  - the moment a probe succeeds it runs tools/chip_sweep.py --tag <tag>
+    --resume; if the sweep completes every step it exits, otherwise (the
+    r4 pattern: the chip answers for a few minutes, then drops mid-sweep)
+    it goes back to probing and re-fires the sweep on the next window —
+    --resume makes the windows accumulate.
 
 Usage: python tools/chip_watch.py [--tag r04] [--interval_s 420]
 """
@@ -58,8 +61,15 @@ def main():
         if up:
             print(f"chip_watch: backend UP at attempt {attempt}: {note}",
                   file=sys.stderr, flush=True)
-            os.execv(py, [py, os.path.join(REPO, "tools", "chip_sweep.py"),
-                          "--tag", args.tag])
+            rc = subprocess.call(
+                [py, os.path.join(REPO, "tools", "chip_sweep.py"),
+                 "--tag", args.tag, "--resume"])
+            with open(log_path, "a") as f:
+                f.write(f"{stamp} sweep fired, rc={rc}\n")
+            if rc == 0:
+                print("chip_watch: sweep complete", file=sys.stderr,
+                      flush=True)
+                return
         time.sleep(args.interval_s)
 
 
