@@ -1,1 +1,1 @@
-from .handle import AsyncIOHandle, aio_handle  # noqa: F401
+from .handle import AsyncIOHandle, aio_handle, uring_available  # noqa: F401

@@ -13,25 +13,44 @@ from typing import Optional
 import numpy as np
 
 
+_BACKENDS = {"auto": 0, "pool": 1, "uring": 2}
+
+
 class AsyncIOHandle:
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
                  single_submit: bool = False, overlap_events: bool = False,
-                 num_threads: int = 1, use_o_direct: bool = False):
+                 num_threads: int = 1, use_o_direct: bool = False,
+                 backend: str = "auto"):
         from op_builder import AsyncIOBuilder
 
         self._lib = AsyncIOBuilder().load()
-        self._lib.ds_aio_handle_create2.restype = ctypes.c_void_p
+        self._lib.ds_aio_handle_create3.restype = ctypes.c_void_p
         self._lib.ds_aio_pread.restype = ctypes.c_int64
         self._lib.ds_aio_pwrite.restype = ctypes.c_int64
         self._lib.ds_aio_wait.restype = ctypes.c_int64
-        # O_DIRECT (reference: libaio O_DIRECT is the default path): aligned
-        # chunks bypass the page cache through per-thread aligned bounce
-        # buffers; filesystems that refuse O_DIRECT degrade to buffered IO
-        self._h = self._lib.ds_aio_handle_create2(
+        self._lib.ds_aio_backend_name.restype = ctypes.c_char_p
+        # backend "uring" is the libaio-io_context equivalent (queue_depth
+        # kernel-async ops in flight off one driver thread); "pool" is the
+        # pread/pwrite worker pool; "auto" currently resolves to pool (the
+        # AIO_r04 sweep measured pool ahead at every point on this host —
+        # flip when uring wins on real NVMe). O_DIRECT (reference: libaio
+        # O_DIRECT is the default path): aligned chunks bypass the page
+        # cache through aligned bounce buffers; filesystems that refuse
+        # O_DIRECT degrade to buffered IO.
+        if backend not in _BACKENDS:
+            raise ValueError(f"backend must be one of {sorted(_BACKENDS)}, "
+                             f"got {backend!r}")
+        self._h = self._lib.ds_aio_handle_create3(
             ctypes.c_int64(block_size), ctypes.c_int(queue_depth),
             ctypes.c_int(int(single_submit)), ctypes.c_int(int(overlap_events)),
-            ctypes.c_int(num_threads), ctypes.c_int(int(use_o_direct)))
+            ctypes.c_int(num_threads), ctypes.c_int(int(use_o_direct)),
+            ctypes.c_int(_BACKENDS[backend]))
+        if not self._h:
+            raise OSError(f"aio backend {backend!r} unavailable on this kernel")
+        self.backend = self._lib.ds_aio_backend_name(
+            ctypes.c_void_p(self._h)).decode()
         self.block_size = block_size
+        self.queue_depth = queue_depth
         self.num_threads = num_threads
         self.use_o_direct = use_o_direct
 
@@ -89,7 +108,15 @@ class AsyncIOHandle:
 
 def aio_handle(block_size: int = 1 << 20, queue_depth: int = 32,
                single_submit: bool = False, overlap_events: bool = False,
-               num_threads: int = 1, use_o_direct: bool = False) -> AsyncIOHandle:
+               num_threads: int = 1, use_o_direct: bool = False,
+               backend: str = "auto") -> AsyncIOHandle:
     """Reference factory name (``deepspeed.ops.aio.aio_handle``)."""
     return AsyncIOHandle(block_size, queue_depth, single_submit, overlap_events,
-                         num_threads, use_o_direct)
+                         num_threads, use_o_direct, backend)
+
+
+def uring_available() -> bool:
+    from op_builder import AsyncIOBuilder
+
+    lib = AsyncIOBuilder().load()
+    return bool(lib.ds_aio_uring_available())

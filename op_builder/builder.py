@@ -26,6 +26,11 @@ class OpBuilder:
     def sources(self) -> List[str]:
         raise NotImplementedError
 
+    def headers(self) -> List[str]:
+        """Headers the sources include — part of the staleness check (a
+        stale shared header otherwise dlopens an ABI-mismatched lib)."""
+        return []
+
     def lib_name(self) -> str:
         return f"libds_{self.NAME}.so"
 
@@ -56,7 +61,9 @@ class OpBuilder:
         if not os.path.exists(lib):
             return True
         lib_mtime = os.path.getmtime(lib)
-        return any(os.path.getmtime(s) > lib_mtime for s in self.absolute_sources())
+        deps = self.absolute_sources() + [os.path.join(CSRC, h)
+                                          for h in self.headers()]
+        return any(os.path.getmtime(d) > lib_mtime for d in deps)
 
     def jit_load(self, verbose: bool = True) -> ctypes.CDLL:
         """Compile (if stale) and dlopen. Reference: ``jit_load`` :472."""
@@ -100,13 +107,17 @@ class CPUAdagradBuilder(OpBuilder):
 
 
 class AsyncIOBuilder(OpBuilder):
-    """Thread-pool pread/pwrite async file IO (reference ``AsyncIOBuilder``;
-    ``csrc/aio/``)."""
+    """Async file IO (reference ``AsyncIOBuilder``; ``csrc/aio/``): io_uring
+    ring backend when the kernel allows it, thread-pool pread/pwrite
+    otherwise."""
 
     NAME = "aio"
 
     def sources(self):
-        return ["aio/ds_aio.cpp"]
+        return ["aio/ds_aio.cpp", "aio/ds_aio_uring.cpp"]
+
+    def headers(self):
+        return ["aio/ds_aio_backend.h"]
 
 
 ALL_OPS: Dict[str, OpBuilder] = {

@@ -17,11 +17,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_point(path, mb, block_size, threads, direct):
+def bench_point(path, mb, block_size, threads, direct, backend="pool",
+                queue_depth=32):
     from deepspeed_tpu.ops.aio import aio_handle
 
     h = aio_handle(block_size=block_size, num_threads=threads,
-                   use_o_direct=direct)
+                   use_o_direct=direct, backend=backend,
+                   queue_depth=queue_depth)
     data = np.random.RandomState(0).bytes(mb << 20)
     buf = np.frombuffer(data, np.uint8).copy()
     # buffered mode must pay for durability INSIDE the timer, else the
@@ -43,8 +45,8 @@ def bench_point(path, mb, block_size, threads, direct):
     t_r = time.perf_counter() - t0
     ok = bool(np.array_equal(out, buf))
     h.close()
-    return {"block_size": block_size, "threads": threads,
-            "o_direct": direct, "mb": mb,
+    return {"backend": backend, "block_size": block_size, "threads": threads,
+            "queue_depth": queue_depth, "o_direct": direct, "mb": mb,
             "write_gbps": round(mb / 1024 / t_w, 2),
             "read_gbps": round(mb / 1024 / t_r, 2),
             "roundtrip_ok": ok}
@@ -61,21 +63,32 @@ def main():
     if args.tiny:
         args.mb = 8
 
+    from deepspeed_tpu.ops.aio import uring_available
+
     d = args.dir or tempfile.mkdtemp(prefix="ds_aio_bench_")
     points = []
-    # r4: widened past the r3 sweep (best sat at its 8 MiB / 8-thread edge —
-    # the thread-pool design's queue depth IS the thread count, so deeper
-    # parallelism and bigger blocks are the remaining levers)
-    blocks = [1 << 20] if args.tiny else [1 << 20, 8 << 20, 32 << 20]
-    threads = [2] if args.tiny else [1, 4, 8, 16]
-    for bs in blocks:
-        for nt in threads:
-            for direct in (False, True):
-                path = os.path.join(d, f"bench_{bs}_{nt}_{int(direct)}.bin")
-                rec = bench_point(path, args.mb, bs, nt, direct)
-                print(json.dumps(rec), flush=True)
-                points.append(rec)
-                os.remove(path)
+    # r4 v2: the pool sweep showed throughput saturating by 8 threads; the
+    # remaining design lever is true kernel queue depth, which only the
+    # uring backend has — sweep it against the pool's best points
+    if args.tiny:
+        grid = [("pool", 1 << 20, 2, 32)]
+        if uring_available():
+            grid.append(("uring", 1 << 20, 1, 32))
+    else:
+        grid = [("pool", bs, nt, 32)
+                for bs in (1 << 20, 8 << 20) for nt in (4, 8, 16)]
+        if uring_available():
+            grid += [("uring", bs, 1, qd)
+                     for bs in (1 << 20, 4 << 20, 8 << 20)
+                     for qd in (16, 64, 256)]
+    for backend, bs, nt, qd in grid:
+        for direct in (False, True):
+            path = os.path.join(d,
+                                f"bench_{backend}_{bs}_{nt}_{qd}_{int(direct)}.bin")
+            rec = bench_point(path, args.mb, bs, nt, direct, backend, qd)
+            print(json.dumps(rec), flush=True)
+            points.append(rec)
+            os.remove(path)
     best_w = max(points, key=lambda r: r["write_gbps"])
     best_r = max(points, key=lambda r: r["read_gbps"])
     print(json.dumps({"metric": "aio_sweep_best", "dir": d,
