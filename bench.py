@@ -74,6 +74,7 @@ def run_candidate(spec, steps=8, warmup=2):
     fq = int(spec.get("fq", 512))
     fk = int(spec.get("fk", 512))
     padam = bool(spec.get("padam", False))
+    attn = spec.get("attn", "flash")
 
     topology.set_mesh(None, None)
     if os.environ.get("DS_BENCH_TINY"):  # harness smoke test (CPU)
@@ -81,12 +82,12 @@ def run_candidate(spec, steps=8, warmup=2):
                           num_hidden_layers=2, num_attention_heads=4,
                           num_key_value_heads=4, max_position_embeddings=SEQ,
                           remat=True, remat_policy=remat_policy,
-                          attention_impl="flash",
+                          attention_impl=attn,
                           flash_block_q=fq, flash_block_k=fk)
     else:
         cfg = LlamaConfig.llama_400m(max_position_embeddings=SEQ, remat=True,
                                      remat_policy=remat_policy,
-                                     attention_impl="flash",
+                                     attention_impl=attn,
                                      flash_block_q=fq, flash_block_k=fk)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
@@ -213,6 +214,12 @@ def main():
     else:
         candidates = [
             {"tag": "dots,B32,f512", "policy": "dots", "batch": 32},
+            # xla-attention insurance: the r4 chip window died inside a
+            # Pallas job — if Mosaic hangs or mis-tiles on this chip, every
+            # flash candidate fails and the headline would read null even
+            # with a healthy MXU; XLA attention at seq 1024 is competitive
+            {"tag": "dots,B32,xla-attn", "policy": "dots", "batch": 32,
+             "attn": "xla", "insurance": True},
             {"tag": "dots,B32,f512,padam", "policy": "dots", "batch": 32,
              "padam": True},
             {"tag": "dots,B32,fq1024k512", "policy": "dots", "batch": 32,
@@ -249,6 +256,10 @@ def main():
             # the full-remat fallback is strictly dominated by any successful
             # dots-remat run (same-or-smaller batch, more recompute)
             break
+        if spec.get("insurance") and best is not None:
+            # the xla-attn insurance only matters when Mosaic is failing;
+            # with a flash number in hand, spend the budget on real levers
+            continue
         # with no success yet, never shrink the cap below what a cold
         # PJRT-init + first-compile needs — overshooting the soft budget
         # beats emitting value=null with a working backend
@@ -260,6 +271,16 @@ def main():
         if not ok:
             log(f"bench: {tag} FAILED: {why}")
             errors.append(f"{tag}: {why}")
+            # r4 chip pattern: the backend answers for minutes, then drops
+            # mid-run — after a timeout, a quick re-probe decides whether to
+            # keep spending the budget or emit what we have right now
+            if why.startswith("timeout after") and not tiny:
+                ok_p, _, _ = _run_sub(_probe_src(), probe_deadline,
+                                      is_src=True)
+                if not ok_p:
+                    log("bench: backend gone mid-sweep — stopping early")
+                    errors.append("backend lost mid-sweep")
+                    break
             continue
         log(f"bench: {tag}: {rec['tflops']:.1f} TFLOPs "
             f"({rec['dt'] * 1e3:.0f} ms/step)")
