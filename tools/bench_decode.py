@@ -183,8 +183,13 @@ def main():
         log(f"bench_decode: {tag}: TTFT {rec['ttft_ms']}ms, "
             f"{rec['decode_tokens_per_sec']} decode tok/s")
         summary["points"].append(rec)
-    if errors:
+    if errors and not summary["points"]:
+        # only a full failure is an "error" (the sweep treats an error
+        # record as not-captured); a partial hardware capture keeps its
+        # points and notes the failed ones separately
         summary["error"] = "; ".join(errors)
+    elif errors:
+        summary["point_errors"] = "; ".join(errors)
     print(json.dumps(summary), flush=True)
 
 
