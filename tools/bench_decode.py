@@ -182,6 +182,10 @@ def main():
             continue
         log(f"bench_decode: {tag}: TTFT {rec['ttft_ms']}ms, "
             f"{rec['decode_tokens_per_sec']} decode tok/s")
+        # stream each point as its own JSON line the moment it lands, so an
+        # OUTER kill (chip_sweep's cap, a dropped backend) loses nothing —
+        # the merger reads these from the dead process's partial stdout
+        print(json.dumps({"point": rec}), flush=True)
         summary["points"].append(rec)
     if errors and not summary["points"]:
         # only a full failure is an "error" (the sweep treats an error

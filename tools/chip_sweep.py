@@ -53,14 +53,32 @@ def probe(py, deadline):
     return None
 
 
-def run_capped(cmd, cap_s, out_path=None):
+def _tee_log(log_name, cmd, stdout, stderr):
+    """Keep full per-step diagnostics (the r4 window lost the per-candidate
+    bench stderr; the winner's "why" was unrecoverable)."""
+    if not log_name:
+        return
+    os.makedirs(os.path.join(REPO, "chip_logs"), exist_ok=True)
+    with open(os.path.join(REPO, "chip_logs", log_name + ".log"), "w") as f:
+        f.write(f"# cmd: {cmd}\n# stdout:\n{stdout or ''}\n"
+                f"# stderr:\n{stderr or ''}\n")
+
+
+def _text(b):
+    return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+
+
+def run_capped(cmd, cap_s, out_path=None, log_name=None):
     t0 = time.time()
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=cap_s,
                            cwd=REPO)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the dominant failure mode IS the timeout — keep its partial output
+        _tee_log(log_name, cmd, _text(e.stdout), _text(e.stderr))
         return {"ok": False, "error": f"timeout after {cap_s:.0f}s",
                 "elapsed_s": round(time.time() - t0, 1)}
+    _tee_log(log_name, cmd, r.stdout, r.stderr)
     lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
     # a tool that could not measure still prints a JSON line with an
     # "error" field — that line must never clobber a good artifact
@@ -87,10 +105,35 @@ def run_capped(cmd, cap_s, out_path=None):
 DECODE_POINTS = 3  # bench_decode's non-tiny sweep: (1,128), (8,512), (32,1024)
 
 
-def run_decode_merged(py, tag, state, impl, cap=900):
+def _merge_decode_lines(stdout, merged, rec):
+    """Fold bench_decode stdout into the per-window point store.
+
+    Understands both the streamed per-point lines ({"point": {...}}) and the
+    final summary ({"points": [...], "error"/"point_errors": ...}); tolerant
+    of truncation (an outer kill mid-line)."""
+    for ln in (stdout or "").splitlines():
+        if not ln.strip().startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        pts = [obj["point"]] if "point" in obj else obj.get("points", [])
+        for pt in pts:
+            merged[f"b{pt['batch']},p{pt['prompt']}"] = pt
+        for k in ("error", "point_errors"):
+            if obj.get(k):
+                rec[k] = str(obj[k])[:300]
+
+
+def run_decode_merged(py, tag, state, impl, cap=1500):
     """Run bench_decode and merge its points into per-window state, so a
     window that captures 1 of 3 points still counts, never clobbers a
-    fuller artifact, and the missing points retry next window."""
+    fuller artifact, and the missing points retry next window.
+
+    cap covers bench_decode's own worst case (60s probe + 3 x 420s point
+    caps); the merge path reads streamed per-point lines out of a timed-out
+    process's partial stdout, so even the outer kill keeps finished points."""
     key = f"decode_points_{impl}"
     merged = state.setdefault(key, {})
     cmd = [py, "tools/bench_decode.py"]
@@ -101,17 +144,16 @@ def run_decode_merged(py, tag, state, impl, cap=900):
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=cap,
                            cwd=REPO)
-        lines = [ln for ln in r.stdout.splitlines()
-                 if ln.strip().startswith("{")]
-        summary = json.loads(lines[-1]) if lines else {}
-        for pt in summary.get("points", []):
-            merged[f"b{pt['batch']},p{pt['prompt']}"] = pt
-        if summary.get("error"):
-            rec["error"] = str(summary["error"])[:300]
-    except subprocess.TimeoutExpired:
+        _merge_decode_lines(r.stdout, merged, rec)
+        _tee_log(f"decode_{impl}", cmd, r.stdout, r.stderr)
+        if r.returncode != 0 and "error" not in rec:
+            rec["error"] = "rc={}: {}".format(
+                r.returncode,
+                (r.stderr.strip().splitlines() or ["?"])[-1][:250])
+    except subprocess.TimeoutExpired as e:
         rec["error"] = f"timeout after {cap}s"
-    except ValueError as e:
-        rec["error"] = f"bad JSON: {e}"
+        _merge_decode_lines(_text(e.stdout), merged, rec)
+        _tee_log(f"decode_{impl}", cmd, _text(e.stdout), _text(e.stderr))
     rec["elapsed_s"] = round(time.time() - t0, 1)
     if merged:
         out = f"DECODE_{tag}.json" if impl == "xla" \
@@ -220,14 +262,25 @@ def main():
     # money-first order; caps sized so the headline survives a short window
     plan = [
         ("bench", [py, "bench.py"], 1800, f"BENCH_{t}_local.json"),
-        ("decode", None, 900, f"DECODE_{t}.json"),           # merge-aware
-        ("decode_pallas", None, 900, f"DECODE_{t}_pallas.json"),
+        # diag separates device capability from per-dispatch tunnel cost —
+        # it explains whatever number bench just produced (r4 window 1:
+        # 3 s/step where r1 had 0.29; the ladder can't be aimed without it)
+        ("diag", [py, "tools/diag_chip.py"], 420, f"DIAG_{t}.json"),
+        # 1500s covers bench_decode's own worst case (probe + 3x420s); the
+        # streamed per-point merge keeps finished points on an outer kill
+        ("decode", None, 1500, f"DECODE_{t}.json"),          # merge-aware
+        ("decode_pallas", None, 1500, f"DECODE_{t}_pallas.json"),
         ("kernels", None, None, f"KERNELS_{t}.json"),  # per-kernel splitter
         ("profile", [py, "tools/profile_train.py", "--quick"], 1200,
          f"PROFILE_{t}.json"),
         ("infinity", [py, "tools/bench_infinity.py"], 900,
          f"INFINITY_{t}_chip.json"),
         ("longctx", [py, "tools/bench_longctx.py"], 1200, f"LONGCTX_{t}.json"),
+        # re-run of the widened ladder (gas-scan candidates + per-candidate
+        # outcome record) AFTER the artifact set is safe — window 1's bench
+        # predates both and its 27.14 winner needs explaining/beating
+        # named bench_v2 so `--skip bench` (prefix match) covers it
+        ("bench_v2", [py, "bench.py"], 1800, f"BENCH_{t}_v2.json"),
     ]
     backend_lost = False
     for name, cmd, cap, artifact in plan:
@@ -251,7 +304,7 @@ def main():
             steps[name] = run_decode_merged(py, t, state, impl, cap)
         else:
             log(f"chip_sweep: {name} (cap {cap}s)")
-            steps[name] = run_capped(cmd, cap, artifact)
+            steps[name] = run_capped(cmd, cap, artifact, log_name=name)
         log(f"chip_sweep: {name}: {steps[name]}")
         save_state()
     save_state()

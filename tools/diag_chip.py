@@ -1,0 +1,126 @@
+"""Chip/tunnel diagnostic: separate device capability from dispatch cost.
+
+The r4 chip window produced a headline of 27 TFLOPs with `offload-dots,B32`
+beating every smaller-batch candidate at 3.07 s/step — where round 1 measured
+0.29 s/step at B8 on the same model. That pattern (bigger batch always wins,
+absolute step time ~10x worse) is the signature of a large FIXED cost per
+dispatched call on the tunneled axon backend, not of slow compute. This tool
+measures the pieces separately so the bench ladder can be aimed:
+
+  1. dispatch cost     — trivial jitted op: chained (fetch once) vs
+                         fetch-per-call roundtrip;
+  2. MXU peak          — bf16 4096^3 matmul chained 32x inside ONE jit
+                         (lax.scan), fetch once: the achievable TFLOPs
+                         ceiling with no per-call overhead;
+  3. matmul per-call   — the same matmul dispatched call-by-call: the gap
+                         to (2) is the per-dispatch tax at realistic sizes;
+  4. HBM bandwidth     — elementwise stream over 256 MiB inside one jit;
+  5. transfer          — H2D device_put and D2H fetch of 64 MiB.
+
+Prints ONE JSON line. Runs anywhere (numbers are only meaningful on chip).
+"""
+
+import json
+import sys
+import time
+
+
+def _t(fn, reps):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import os
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # sitecustomize pre-imports jax before env vars can act; switch the
+    # still-uninitialized backend via config (same dance as conftest/bench)
+    if "--cpu" in sys.argv or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    out = {"metric": "chip_diag", "backend": jax.default_backend(),
+           "device": str(jax.devices()[0])}
+    on_chip = out["backend"] not in ("cpu",)
+
+    # 1) dispatch cost
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8, 128), jnp.float32)
+    float(f(x)[0, 0])  # compile
+
+    def chained():
+        y = x
+        for _ in range(10):
+            y = f(y)
+        float(y[0, 0])
+    out["dispatch_chained10_fetch1_ms"] = round(_t(chained, 3) / 10 * 1e3, 2)
+    out["dispatch_fetch_each_ms"] = round(
+        _t(lambda: float(f(x)[0, 0]), 10) * 1e3, 2)
+
+    # 2) MXU peak, one dispatch
+    n, iters = (4096, 32) if on_chip else (512, 4)  # CPU: smoke-only shapes
+    key = jax.random.PRNGKey(0)
+    a = (jax.random.normal(key, (n, n), jnp.float32) * 0.02).astype(jnp.bfloat16)
+    b = jnp.eye(n, dtype=jnp.bfloat16)
+
+    @jax.jit
+    def peak(a, b):
+        def body(c, _):
+            return jnp.dot(a, c, preferred_element_type=jnp.bfloat16), ()
+        c, _ = lax.scan(body, b, None, length=iters)
+        return c
+    float(peak(a, b)[0, 0].astype(jnp.float32))  # compile
+    dt = _t(lambda: float(peak(a, b)[0, 0].astype(jnp.float32)), 3)
+    out["mxu_scan_tflops"] = round(2.0 * n ** 3 * iters / dt / 1e12, 1)
+
+    # 3) same matmul per-dispatch (16 calls, fetch once)
+    g = jax.jit(lambda a, c: jnp.dot(a, c, preferred_element_type=jnp.bfloat16))
+    float(g(a, b)[0, 0].astype(jnp.float32))
+
+    def percall():
+        c = b
+        for _ in range(16):
+            c = g(a, c)
+        float(c[0, 0].astype(jnp.float32))
+    dt = _t(percall, 3) / 16
+    out["mxu_percall_tflops"] = round(2.0 * n ** 3 / dt / 1e12, 1)
+    out["mxu_percall_ms"] = round(dt * 1e3, 2)
+
+    # 4) HBM stream: read 256 MiB + write 256 MiB per iter, 16 iters, one jit
+    m = (64 if on_chip else 4) * 1024 * 1024  # 64M f32 = 256 MiB
+    v = jnp.ones((m,), jnp.float32)
+
+    @jax.jit
+    def stream(v):
+        def body(c, _):
+            return c * 1.0000001 + 0.5, ()
+        c, _ = lax.scan(body, v, None, length=16)
+        return c
+    float(stream(v)[0])
+    dt = _t(lambda: float(stream(v)[0]), 3)
+    out["hbm_gbps"] = round(16 * 2 * m * 4 / dt / 1e9, 1)
+
+    # 5) tunnel transfer bandwidth, 64 MiB each way
+    h = np.ones(((16 if on_chip else 4) * 1024 * 1024,), np.float32)
+    dt = _t(lambda: jax.device_put(h).block_until_ready(), 3)
+    out["h2d_gbps"] = round(h.nbytes / dt / 1e9, 2)
+    d = jax.device_put(h)
+    dt = _t(lambda: np.asarray(d), 3)
+    out["d2h_gbps"] = round(h.nbytes / dt / 1e9, 2)
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"metric": "chip_diag", "value": None,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+        sys.exit(1)
