@@ -21,11 +21,17 @@ Prints ONE JSON line. Runs anywhere (numbers are only meaningful on chip).
 """
 
 import json
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _timing import time_fn  # noqa: E402  (fence-by-value-fetch convention)
+
 
 def _t(fn, reps):
+    """Wall time per rep for callables that carry their OWN device fence
+    (a float() fetch inside fn). Compute/stream sections use time_fn."""
     t0 = time.perf_counter()
     for _ in range(reps):
         fn()
@@ -57,10 +63,10 @@ def main():
         y = x
         for _ in range(10):
             y = f(y)
-        float(y[0, 0])
+        float(y[0, 0])  # fence
     out["dispatch_chained10_fetch1_ms"] = round(_t(chained, 3) / 10 * 1e3, 2)
     out["dispatch_fetch_each_ms"] = round(
-        _t(lambda: float(f(x)[0, 0]), 10) * 1e3, 2)
+        _t(lambda: float(f(x)[0, 0]), 10) * 1e3, 2)  # fence per call
 
     # 2) MXU peak, one dispatch
     n, iters = (4096, 32) if on_chip else (512, 4)  # CPU: smoke-only shapes
@@ -74,20 +80,17 @@ def main():
             return jnp.dot(a, c, preferred_element_type=jnp.bfloat16), ()
         c, _ = lax.scan(body, b, None, length=iters)
         return c
-    float(peak(a, b)[0, 0].astype(jnp.float32))  # compile
-    dt = _t(lambda: float(peak(a, b)[0, 0].astype(jnp.float32)), 3)
+    dt = time_fn(peak, a, b, steps=3, warmup=1)
     out["mxu_scan_tflops"] = round(2.0 * n ** 3 * iters / dt / 1e12, 1)
 
     # 3) same matmul per-dispatch (16 calls, fetch once)
     g = jax.jit(lambda a, c: jnp.dot(a, c, preferred_element_type=jnp.bfloat16))
-    float(g(a, b)[0, 0].astype(jnp.float32))
 
-    def percall():
-        c = b
+    def sixteen(a, c):
         for _ in range(16):
             c = g(a, c)
-        float(c[0, 0].astype(jnp.float32))
-    dt = _t(percall, 3) / 16
+        return c
+    dt = time_fn(sixteen, a, b, steps=3, warmup=1) / 16
     out["mxu_percall_tflops"] = round(2.0 * n ** 3 / dt / 1e12, 1)
     out["mxu_percall_ms"] = round(dt * 1e3, 2)
 
@@ -101,17 +104,28 @@ def main():
             return c * 1.0000001 + 0.5, ()
         c, _ = lax.scan(body, v, None, length=16)
         return c
-    float(stream(v)[0])
-    dt = _t(lambda: float(stream(v)[0]), 3)
+    dt = time_fn(stream, v, steps=3, warmup=1)
     out["hbm_gbps"] = round(16 * 2 * m * 4 / dt / 1e9, 1)
 
-    # 5) tunnel transfer bandwidth, 64 MiB each way
+    # 5) tunnel transfer bandwidth, 64 MiB each way. Fences are value
+    # fetches (block_until_ready returns early on the tunneled platform),
+    # and each rep uses a FRESH array: jax caches the host copy of an
+    # already-fetched Array, so re-fetching the same one times a memcpy
     h = np.ones(((16 if on_chip else 4) * 1024 * 1024,), np.float32)
-    dt = _t(lambda: jax.device_put(h).block_until_ready(), 3)
-    out["h2d_gbps"] = round(h.nbytes / dt / 1e9, 2)
-    d = jax.device_put(h)
-    dt = _t(lambda: np.asarray(d), 3)
-    out["d2h_gbps"] = round(h.nbytes / dt / 1e9, 2)
+    nbytes = h.nbytes
+    float(jax.device_put(h)[0])  # warm the transfer path
+    dt = _t(lambda: float(jax.device_put(h)[0]), 3)  # fresh device array/rep
+    out["h2d_gbps"] = round(nbytes / dt / 1e9, 2)
+    devs = []
+    for i in range(3):
+        d = jax.device_put(h + float(i))
+        float(d[0])  # land it before timing the fetch
+        devs.append(d)
+    t0 = time.perf_counter()
+    for d in devs:
+        np.asarray(d)
+    dt = (time.perf_counter() - t0) / 3
+    out["d2h_gbps"] = round(nbytes / dt / 1e9, 2)
 
     print(json.dumps(out), flush=True)
 
