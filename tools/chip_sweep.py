@@ -279,13 +279,15 @@ def main():
     ]
     if steps.get("bench", {}).get("ok"):
         # the captured bench predates THIS sweep process (resume from an
-        # earlier window) — re-run the ladder at the end, after the artifact
-        # set is safe: window 1's 27.14 winner predates the gas-scan
-        # candidates + per-candidate outcome record and needs beating. On a
-        # fresh sweep the first bench step already runs the current ladder.
-        # Named bench_v2 so `--skip bench` (prefix match) covers it.
-        plan.append(("bench_v2", [py, "bench.py"], 1800,
-                     f"BENCH_{t}_v2.json"))
+        # earlier window): re-run the ladder right after diag — the headline
+        # is the verdict's #1 item and window 1's 27.14 winner predates the
+        # per-step-fence fix and the gas-scan candidates. Budget 900s (not
+        # the full 1500s default) so a ~12-min window still reaches decode.
+        # On a fresh sweep the first bench step already runs the current
+        # ladder. Named bench_v2 so `--skip bench` (prefix match) covers it.
+        plan.insert(2, ("bench_v2",
+                        ["env", "DS_BENCH_BUDGET_S=900", py, "bench.py"],
+                        1100, f"BENCH_{t}_v2.json"))
     backend_lost = False
     for name, cmd, cap, artifact in plan:
         if name.split("_")[0] in skip:
