@@ -10,22 +10,26 @@ import time
 import numpy as np
 
 
-def time_fn(fn, *args, steps: int = 5, warmup: int = 1) -> float:
-    """Mean seconds/step. Warms up (compiles), fences, times ``steps``."""
+def fence(out) -> None:
+    """Land ``out``: fetch one scalar from its last array leaf. The ONE copy
+    of the repo's device-fence convention (value fetch; block_until_ready
+    returns early on the tunneled TPU platform)."""
     import jax
 
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
+    if leaves:
+        np.asarray(jax.device_get(
+            leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
+
+
+def time_fn(fn, *args, steps: int = 5, warmup: int = 1) -> float:
+    """Mean seconds/step. Warms up (compiles), fences, times ``steps``."""
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
-    if leaves:
-        np.asarray(jax.device_get(
-            leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
+    fence(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = fn(*args)
-    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "shape")]
-    if leaves:
-        np.asarray(jax.device_get(
-            leaves[-1].ravel()[0] if leaves[-1].ndim else leaves[-1]))
+    fence(out)
     return (time.perf_counter() - t0) / steps

@@ -26,19 +26,22 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # tools/ for _timing
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       "/tmp/deepspeed_tpu_jax_bench_cache")
 
 
 def _timeit(fn, *args, reps=5):
-    import jax
+    """Best-of-reps latency, fenced by the shared scalar-fetch fence — NOT
+    block_until_ready, which returns early on the tunneled TPU platform."""
+    from _timing import fence
 
-    fn(*args)  # compile
+    fence(fn(*args))  # compile + land
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        fence(fn(*args))
         best = min(best, time.perf_counter() - t0)
     return best * 1e3  # ms
 
