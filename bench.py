@@ -78,6 +78,7 @@ def run_candidate(spec, steps=8, warmup=2):
     fk = int(spec.get("fk", 512))
     padam = bool(spec.get("padam", False))
     attn = spec.get("attn", "flash")
+    lchunk = int(spec.get("lchunk", 0))  # chunked xent: no [B,T,V] logits
     global_bs = batch * gas
 
     topology.set_mesh(None, None)
@@ -87,12 +88,14 @@ def run_candidate(spec, steps=8, warmup=2):
                           num_key_value_heads=4, max_position_embeddings=SEQ,
                           remat=True, remat_policy=remat_policy,
                           attention_impl=attn,
-                          flash_block_q=fq, flash_block_k=fk)
+                          flash_block_q=fq, flash_block_k=fk,
+                          loss_chunk=lchunk)
     else:
         cfg = LlamaConfig.llama_400m(max_position_embeddings=SEQ, remat=True,
                                      remat_policy=remat_policy,
                                      attention_impl=attn,
-                                     flash_block_q=fq, flash_block_k=fk)
+                                     flash_block_q=fq, flash_block_k=fk,
+                                     loss_chunk=lchunk)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (global_bs, SEQ)).astype(np.int32)
@@ -193,6 +196,12 @@ def _best_window_capture():
                 rec = json.loads(f.read().strip().splitlines()[-1])
         except (ValueError, OSError, IndexError):  # empty/truncated artifact
             continue
+        if rec.get("error"):
+            # never re-surface a record that was ITSELF a fallback or a
+            # failed run — chip_sweep can persist bench's cached-fallback
+            # output as a new round's artifact, and accepting it here would
+            # relabel an old measurement with a newer round every outage
+            continue
         if rec.get("value") and (best is None or rec["value"] > best["value"]):
             rec["_artifact"] = name
             rec["_round"] = rn
@@ -263,6 +272,8 @@ def main():
             {"tag": "dots,B8,f512", "policy": "dots", "batch": 8},
             {"tag": "dots,m4xgas2,f512", "policy": "dots", "batch": 4,
              "gas": 2},
+            {"tag": "dots,B8,f512,lc128", "policy": "dots", "batch": 8,
+             "lchunk": 128},
             {"tag": "dots,B8,f512,padam", "policy": "dots", "batch": 8,
              "padam": True},
             {"tag": "full-remat,B8", "policy": "nothing", "batch": 8},
@@ -274,10 +285,12 @@ def main():
             # of a multi-second FIXED cost per dispatched call on the
             # tunneled backend — the GAS scan runs `gas` micro-steps inside
             # ONE compiled call, amortizing that cost without changing math
+            {"tag": "dots,m8xgas8,f512,lc2048", "policy": "dots", "batch": 8,
+             "gas": 8, "lchunk": 2048},  # + chunked xent: no [B,T,V] logits
             {"tag": "dots,m8xgas8,f512", "policy": "dots", "batch": 8,
              "gas": 8},
-            {"tag": "dots,m16xgas4,f512", "policy": "dots", "batch": 16,
-             "gas": 4},
+            {"tag": "dots,m16xgas4,f512,lc2048", "policy": "dots", "batch": 16,
+             "gas": 4, "lchunk": 2048},
             # xla-attention insurance: if Mosaic hangs or mis-tiles on this
             # chip, every flash candidate fails and the headline would read
             # null even with a healthy MXU; XLA attention at 1k is competitive

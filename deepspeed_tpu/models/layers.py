@@ -243,7 +243,109 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
     return nll.sum() / jnp.maximum(mask.sum(), 1.0)
 
 
+def chunked_cross_entropy_loss(hidden: jnp.ndarray, w_out: jnp.ndarray,
+                               labels: jnp.ndarray, *,
+                               bias: jnp.ndarray = None,
+                               ignore_index: int = -100,
+                               chunk: int = 2048) -> jnp.ndarray:
+    """Token-mean cross entropy WITHOUT materializing ``[tokens, vocab]``.
+
+    The plain path computes bf16 logits ``[B,T,V]`` and casts them to fp32 —
+    at the bench shapes (B32, T1024, V32k) that is a 2 GB + 4 GB temp and
+    the backward touches it all again: the loss layer becomes an HBM-
+    bandwidth sink. Here the head projection + logsumexp run inside a
+    ``lax.scan`` over token chunks with a rematerialized body, so peak
+    memory is ``O(chunk * vocab)`` and the full logits never exist; the
+    backward recomputes each chunk's logits (≈ +1/3 of the lm-head FLOPs,
+    a few % of the model) while the head-weight gradient accumulates
+    across chunks in the scan's backward. Matches ``cross_entropy_loss``
+    math (fp32 logsumexp, fp32 matmul accumulation) up to reduction order
+    and — for an untied fp32 head with low-precision activations — the
+    head weights being rounded to the activation dtype for the MXU.
+    Reference counterpart: the fused softmax/xent CUDA kernels
+    (``csrc/transformer/softmax_kernels.cu``) — the TPU-native answer is a
+    compiler-scheduled chunk scan, not a hand-written kernel.
+
+    ``hidden``: [B, T, H] pre-head activations (any float dtype);
+    ``w_out``: [H, V] head projection (``embed.T`` when tied);
+    ``labels``: [B, T] ALREADY shifted, ``ignore_index`` masked out.
+    """
+    b, t, h = hidden.shape
+    n = b * t
+    hs = hidden.reshape(n, h)
+    ys = labels.reshape(n)
+    pad = (-n) % chunk
+    if pad:
+        hs = jnp.concatenate([hs, jnp.zeros((pad, h), hs.dtype)], axis=0)
+        ys = jnp.concatenate(
+            [ys, jnp.full((pad,), ignore_index, ys.dtype)], axis=0)
+    hs = hs.reshape(-1, chunk, h)
+    ys = ys.reshape(-1, chunk)
+
+    def body(carry, hy):
+        hc, yc = hy
+        # operands in the activation dtype (bf16 on chip -> MXU-native),
+        # accumulation in fp32: for an untied fp32 head this rounds the
+        # WEIGHTS to bf16 where the plain path runs an fp32 matmul — the
+        # standard TPU head discipline, and the only numeric difference
+        # beyond reduction order (exact when activations are fp32)
+        logits = jnp.dot(hc, w_out.astype(hc.dtype),
+                         preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        mask = (yc != ignore_index)
+        safe = jnp.where(mask, yc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        nll = jnp.where(mask, logz - gold, 0.0)
+        s, c = carry
+        return (s + nll.sum(), c + mask.sum().astype(jnp.float32)), None
+
+    (s, c), _ = jax.lax.scan(jax.checkpoint(body),
+                             (jnp.float32(0.0), jnp.float32(0.0)), (hs, ys))
+    return s / jnp.maximum(c, 1.0)
+
+
 def shift_labels(input_ids: jnp.ndarray, ignore_index: int = -100) -> jnp.ndarray:
     """HF convention: labels == input_ids; shift left, pad tail with ignore."""
     return jnp.concatenate(
         [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], ignore_index)], axis=1)
+
+
+def lm_head_output(parent, cfg, hidden, labels, cache, head_bias=False):
+    """Shared LM-head dispatch for the causal-LM model classes.
+
+    Returns ``(logits, loss)`` where exactly one is non-None: the training
+    path with ``cfg.loss_chunk > 0`` goes through
+    :func:`chunked_cross_entropy_loss` and never materializes logits
+    (``logits is None``); every other path returns full logits and leaves
+    the loss to the caller. Must be called from the parent module's compact
+    ``__call__`` frame (it creates the ``lm_head`` Dense there; the
+    zero-width ``head(hidden[:, :0, :])`` call creates the params without
+    computing logits when only the kernel is needed).
+    """
+    import flax.linen as nn
+
+    chunked = bool(getattr(cfg, "loss_chunk", 0)) \
+        and cache is None and labels is not None
+    bias = None
+    if cfg.tie_word_embeddings:
+        w_out = parent.variables["params"]["model"]["embed_tokens"][
+            "embedding"].T
+        logits = None if chunked else hidden @ w_out.astype(hidden.dtype)
+    else:
+        head = nn.Dense(cfg.vocab_size, use_bias=head_bias, name="lm_head",
+                        param_dtype=jnp.float32)
+        if chunked:
+            head(hidden[:, :0, :])
+            w_out = parent.variables["params"]["lm_head"]["kernel"]
+            if head_bias:
+                bias = parent.variables["params"]["lm_head"]["bias"]
+            logits = None
+        else:
+            logits = head(hidden)
+    if not chunked:
+        return logits, None
+    return None, chunked_cross_entropy_loss(hidden, w_out,
+                                            shift_labels(labels), bias=bias,
+                                            chunk=cfg.loss_chunk)

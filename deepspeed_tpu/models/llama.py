@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import (RMSNorm, apply_rotary, cache_attention_bias, cross_entropy_loss,
+from .layers import (RMSNorm, apply_rotary, cache_attention_bias,
+                     cross_entropy_loss, lm_head_output,
                      dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache)
@@ -70,6 +71,10 @@ class LlamaConfig:
     #   "offload_dots_no_batch" - like dots_no_batch but residuals live in
     #                pinned host memory (CPU activation checkpointing)
     remat_policy: str = "nothing"
+    #: >0: training loss runs as a remat'd scan over token chunks of this
+    #: size — the [tokens, vocab] logits tensor is never materialized
+    #: (models/layers.py chunked_cross_entropy_loss). 0 = plain loss.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -297,18 +302,14 @@ class LlamaForCausalLM(nn.Module):
                                                pld_theta)
         if cache is not None:
             hidden, cache = hidden
-        if cfg.tie_word_embeddings:
-            embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
-            logits = hidden @ embed.T.astype(hidden.dtype)
-        else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                              param_dtype=jnp.float32)(hidden)
+        logits, loss = lm_head_output(self, cfg, hidden, labels, cache)
         if cache is not None:
             return logits, cache
         if labels is None:
             return logits
-        shifted = shift_labels(labels)
-        return cross_entropy_loss(logits, shifted)
+        if loss is not None:
+            return loss
+        return cross_entropy_loss(logits, shift_labels(labels))
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
         """Empty KV cache for incremental decoding."""

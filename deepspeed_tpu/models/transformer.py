@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
+                     lm_head_output,
                      init_kv_cache, repeat_kv, resolve_remat_policy,
                      rotary_embedding, shift_labels, update_kv_cache)
 from .layers import apply_rotary as _apply_rotary_full
@@ -73,6 +74,10 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "nothing"
+    #: >0: training loss runs as a remat'd scan over token chunks of this
+    #: size — the [tokens, vocab] logits tensor is never materialized
+    #: (models/layers.py chunked_cross_entropy_loss). 0 = plain loss.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -393,16 +398,14 @@ class TransformerLMHeadModel(nn.Module):
             cache_index)
         if cache is not None:
             hidden, cache = hidden
-        if cfg.tie_word_embeddings:
-            embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
-            logits = hidden @ embed.T.astype(hidden.dtype)
-        else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
-                              name="lm_head", param_dtype=jnp.float32)(hidden)
+        logits, loss = lm_head_output(self, cfg, hidden, labels, cache,
+                                      head_bias=cfg.lm_head_bias)
         if cache is not None:
             return logits, cache
         if labels is None:
             return logits
+        if loss is not None:
+            return loss
         return cross_entropy_loss(logits, shift_labels(labels))
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
