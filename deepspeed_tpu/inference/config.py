@@ -53,6 +53,11 @@ class DeepSpeedInferenceConfig:
     #: int8 weight quantization (reference quantization_setting / GroupQuantizer)
     quantize: bool = False
     quantize_groups: int = 32
+    #: int8 KV cache: halves decode-step cache bandwidth (the decode
+    #: bottleneck); quantized at append with per-(position, head) absmax
+    #: scales, dequantized per block in VMEM by the Pallas decode kernel
+    #: (models/layers.py init_kv_cache; reference int8 inference kernels)
+    kv_cache_int8: bool = False
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
 

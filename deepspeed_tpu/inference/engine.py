@@ -142,7 +142,9 @@ class InferenceEngine:
 
                 params = dequantize_params(params, dequant_meta, compute_dtype)
             B, T = input_ids.shape
-            cache = module.init_cache(B, cache_len, dtype=compute_dtype)
+            cache = module.init_cache(
+                B, cache_len,
+                dtype=jnp.int8 if self.config.kv_cache_int8 else compute_dtype)
             key_mask = jnp.zeros((B, cache_len), jnp.int32)
             key_mask = jax.lax.dynamic_update_slice(key_mask, attention_mask.astype(
                 jnp.int32), (0, 0))

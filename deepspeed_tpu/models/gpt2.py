@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
-                     init_kv_cache, make_causal_mask, shift_labels, update_kv_cache)
+                     init_kv_cache, make_causal_mask, read_kv_cache,
+                     shift_labels, update_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,8 +59,7 @@ class GPT2Attention(nn.Module):
         v = v.reshape(B, T, H, D)
         if layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
-            k = layer_cache["k"].astype(x.dtype)
-            v = layer_cache["v"].astype(x.dtype)
+            k, v = read_kv_cache(layer_cache, x.dtype)
             bias = cache_attention_bias(T, k.shape[1], cache_index, key_mask=mask)
             out = dot_product_attention(q, k, v, bias=bias, causal=False)
         else:

@@ -193,15 +193,69 @@ def init_kv_cache(batch: int, max_len: int, num_kv_heads: int, head_dim: int,
     leading layer axis is present when the model scans its blocks, so the
     cache threads through ``nn.scan`` as per-layer xs/ys.
     """
+    if dtype == jnp.int8:
+        # int8 cache: values quantized per (position, kv head) with an
+        # absmax scale — halves the HBM traffic of every decode step (the
+        # cache read IS the decode bottleneck). Scales live alongside in
+        # fp32; the Pallas decode kernel dequantizes per block in VMEM, the
+        # XLA fallback dequantizes on read. Counterpart of the reference's
+        # int8 inference kernels (SURVEY row 46 "int8").
+        shape = (batch, max_len, num_kv_heads, head_dim)
+        sshape = (batch, max_len, num_kv_heads)
+        if n_layers is not None:
+            shape = (n_layers,) + shape
+            sshape = (n_layers,) + sshape
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
     shape = (batch, max_len, num_kv_heads, head_dim)
     if n_layers is not None:
         shape = (n_layers,) + shape
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def _quantize_kv(x):
+    """[B, T, Hkv, D] -> (int8 values, fp32 absmax-per-(pos, head) scales)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / 127.0
+    q = jnp.round(x.astype(jnp.float32)
+                  / jnp.maximum(scale, 1e-8)[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of ``_quantize_kv`` (broadcast the per-row scale over D)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def read_kv_cache(layer_cache, dtype):
+    """Materialize ``(k, v)`` in ``dtype`` from a cache dict — THE accessor
+    every attention implementation must use (an int8 cache dequantizes here;
+    reading ``layer_cache["k"]`` directly would hand raw int8 codes to the
+    attention math)."""
+    if "k_scale" in layer_cache:
+        return (dequantize_kv(layer_cache["k"], layer_cache["k_scale"], dtype),
+                dequantize_kv(layer_cache["v"], layer_cache["v_scale"], dtype))
+    return layer_cache["k"].astype(dtype), layer_cache["v"].astype(dtype)
+
+
 def update_kv_cache(layer_cache, k, v, cache_index):
-    """Append ``[B, T, Hkv, D]`` keys/values at ``cache_index`` (traced ok)."""
+    """Append ``[B, T, Hkv, D]`` keys/values at ``cache_index`` (traced ok).
+    An int8 cache (see ``init_kv_cache``) quantizes at append time."""
     idx = (0, cache_index, 0, 0)
+    if "k_scale" in layer_cache:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        sidx = (0, cache_index, 0)
+        return {
+            "k": jax.lax.dynamic_update_slice(layer_cache["k"], kq, idx),
+            "v": jax.lax.dynamic_update_slice(layer_cache["v"], vq, idx),
+            "k_scale": jax.lax.dynamic_update_slice(
+                layer_cache["k_scale"], ks, sidx),
+            "v_scale": jax.lax.dynamic_update_slice(
+                layer_cache["v_scale"], vs, sidx),
+        }
     return {
         "k": jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype), idx),
         "v": jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype), idx),

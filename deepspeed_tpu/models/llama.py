@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .layers import (RMSNorm, apply_rotary, cache_attention_bias,
-                     cross_entropy_loss, lm_head_output,
+                     cross_entropy_loss, lm_head_output, read_kv_cache,
                      dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache)
@@ -130,16 +130,20 @@ class LlamaAttention(nn.Module):
             if T == 1 and cfg.decode_attention_impl == "pallas":
                 # Pallas decode kernel: streams the cache once per kv head
                 # (GQA heads share the pass, no repeat_kv copy) and skips
-                # blocks beyond the filled prefix
+                # blocks beyond the filled prefix; an int8 cache is
+                # dequantized per block in VMEM (HBM reads stay int8)
                 from ..ops.pallas.decode_attention import decode_attention
 
                 out = decode_attention(q[:, 0], layer_cache["k"],
                                        layer_cache["v"], cache_index,
                                        key_mask=mask,
+                                       k_scale=layer_cache.get("k_scale"),
+                                       v_scale=layer_cache.get("v_scale"),
                                        window=cfg.sliding_window)[:, None]
             else:
-                k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
-                v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+                kc, vc = read_kv_cache(layer_cache, x.dtype)
+                k = repeat_kv(kc, H // Hkv)
+                v = repeat_kv(vc, H // Hkv)
                 bias = cache_attention_bias(T, k.shape[1], cache_index,
                                             key_mask=mask,
                                             window=cfg.sliding_window)

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
+from .layers import (cache_attention_bias, cross_entropy_loss,
+                     dot_product_attention, read_kv_cache,
                      lm_head_output,
                      init_kv_cache, repeat_kv, resolve_remat_policy,
                      rotary_embedding, shift_labels, update_kv_cache)
@@ -182,10 +183,13 @@ class GenericAttention(nn.Module):
                 out = decode_attention(q[:, 0], layer_cache["k"],
                                        layer_cache["v"], cache_index,
                                        key_mask=bias,
+                                       k_scale=layer_cache.get("k_scale"),
+                                       v_scale=layer_cache.get("v_scale"),
                                        sm_scale=cfg.attention_scale)[:, None]
             else:
-                k = repeat_kv(layer_cache["k"].astype(x.dtype), H // Hkv)
-                v = repeat_kv(layer_cache["v"].astype(x.dtype), H // Hkv)
+                kc, vc = read_kv_cache(layer_cache, x.dtype)
+                k = repeat_kv(kc, H // Hkv)
+                v = repeat_kv(vc, H // Hkv)
                 out = dot_product_attention(q, k, v, bias=bias, causal=False,
                                             scale=cfg.attention_scale)
         else:

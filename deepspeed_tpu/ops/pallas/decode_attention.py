@@ -33,9 +33,13 @@ def _ceil_div(a, b):
     return (a + b - 1) // b
 
 
-def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale: float, block_k: int,
-                   s_total: int, window):
+def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, *rest,
+                   sm_scale: float, block_k: int, s_total: int, window,
+                   int8: bool):
+    if int8:
+        ks_ref, vs_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        mask_ref, o_ref, m_scr, l_scr, acc_scr = rest
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -58,6 +62,11 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)     # [G, D]
         k = k_ref[0, 0].astype(jnp.float32)     # [bk, D]
         v = v_ref[0, 0].astype(jnp.float32)     # [bk, D]
+        if int8:
+            # int8 cache: HBM->VMEM moved half the bytes; dequantize here
+            # with the per-(position, kv head) absmax scales
+            k = k * ks_ref[0, 0][:, None]
+            v = v * vs_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
@@ -105,7 +114,9 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      sm_scale: Optional[float] = None, block_k: int = 256,
                      interpret: Optional[bool] = None,
                      force_pallas: bool = False,
-                     window: Optional[int] = None) -> jnp.ndarray:
+                     window: Optional[int] = None,
+                     k_scale: Optional[jnp.ndarray] = None,
+                     v_scale: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Single-position cached attention.
 
     q: ``[B, H, D]`` (the one new token's query heads), k_cache/v_cache:
@@ -113,14 +124,25 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     tokens (the new token sits at that position), ``key_mask``: ``[B, S]``
     1 = real token. Returns ``[B, H, D]``.
 
+    An int8 cache passes ``k_scale``/``v_scale`` ``[B, S, Hkv]`` (see
+    ``models/layers.py init_kv_cache``): the kernel reads int8 from HBM —
+    half the decode bandwidth — and dequantizes per block in VMEM. The
+    reference's int8 inference kernels dequantize in shared memory the same
+    way (``csrc/transformer/inference``, SURVEY row 46).
+
     ``interpret=None`` auto-selects: real kernel on TPU, the XLA reference
     math elsewhere (interpret mode available for kernel-parity tests).
     """
+    int8 = k_scale is not None
     if interpret is None:
         on_tpu = jax.default_backend() == "tpu"
         if not on_tpu and not force_pallas:
             if sm_scale is None:
                 sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+            if int8:
+                from ...models.layers import dequantize_kv
+                k_cache = dequantize_kv(k_cache, k_scale, q.dtype)
+                v_cache = dequantize_kv(v_cache, v_scale, q.dtype)
             return _reference_decode(q, k_cache, v_cache, cache_index,
                                      key_mask, sm_scale, window=window)
         interpret = not on_tpu
@@ -145,6 +167,11 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         key_mask = jnp.ones((B, S), jnp.int32)
     key_mask = jnp.pad(key_mask.astype(jnp.int32), ((0, 0), (0, pad)))
     cidx = jnp.asarray(cache_index, jnp.int32).reshape(1)
+    scales = []
+    if int8:
+        for s in (k_scale, v_scale):
+            st = jnp.swapaxes(s.astype(jnp.float32), 1, 2)  # [B, Hkv, S]
+            scales.append(jnp.pad(st, ((0, 0), (0, 0), (0, pad))))
 
     nk = _ceil_div(S, bk)
 
@@ -159,15 +186,21 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     def mask_idx(b, h, ik, cidx_ref):
         return (b, jnp.minimum(ik, cidx_ref[0] // bk))
 
+    def scale_idx(b, h, ik, cidx_ref):
+        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bk, D), kv_idx),
+        pl.BlockSpec((1, 1, bk, D), kv_idx),
+    ]
+    if int8:
+        in_specs += [pl.BlockSpec((1, 1, bk), scale_idx)] * 2
+    in_specs.append(pl.BlockSpec((1, bk), mask_idx))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, Hkv, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bk, D), kv_idx),
-            pl.BlockSpec((1, 1, bk, D), kv_idx),
-            pl.BlockSpec((1, bk), mask_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((G, 1), jnp.float32),
@@ -177,9 +210,9 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=bk,
-                          s_total=S, window=window),
+                          s_total=S, window=window, int8=int8),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         interpret=interpret,
-    )(cidx, qg, kt, vt, key_mask)
+    )(cidx, qg, kt, vt, *scales, key_mask)
     return out.reshape(B, H, D)

@@ -119,3 +119,71 @@ def test_generic_transformer_pallas_decode_ineligible_alibi():
     np.testing.assert_array_equal(
         np.asarray(eng.generate(ids, max_new_tokens=5, do_sample=False)),
         np.asarray(base.generate(ids, max_new_tokens=5, do_sample=False)))
+
+
+def test_int8_cache_kernel_parity():
+    """int8-cache kernel (per-block VMEM dequant) must match the XLA path
+    operating on the SAME quantized values exactly — quantization noise is
+    common to both, so tolerances stay tight."""
+    from deepspeed_tpu.models.layers import _quantize_kv, dequantize_kv
+
+    rs = np.random.RandomState(5)
+    B, S, H, Hkv, D = 2, 64, 8, 2, 16
+    q = jnp.asarray(rs.randn(B, H, D).astype(np.float32))
+    kc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
+    kq, ks = _quantize_kv(kc)
+    vq, vs = _quantize_kv(vc)
+    got = decode_attention(q, kq, vq, 33, k_scale=ks, v_scale=vs,
+                           block_k=16, interpret=True, force_pallas=True)
+    ref = _ref(q, dequantize_kv(kq, ks), dequantize_kv(vq, vs), 33, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # and the quantization itself is faithful (absmax per row: ~1/254 rel)
+    np.testing.assert_allclose(np.asarray(dequantize_kv(kq, ks)),
+                               np.asarray(kc), atol=0.02)
+
+
+def test_int8_cache_generate_close_to_bf16():
+    """Model-level: kv_cache_int8 generates from the same tiny Llama with
+    logits-path quantization noise only — greedy tokens match on a tiny
+    model whose logit gaps exceed the cache noise."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.RandomState(7).randint(0, cfg.vocab_size, (2, 10))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.asarray(ids))["params"]
+    eng = ds.init_inference(model, params=params, max_out_tokens=20)
+    base = np.asarray(eng.generate(ids, max_new_tokens=6, do_sample=False))
+    eng8 = ds.init_inference(model, params=params, max_out_tokens=20,
+                             kv_cache_int8=True)
+    got = np.asarray(eng8.generate(ids, max_new_tokens=6, do_sample=False))
+    assert got.shape == base.shape
+    # prompt part identical by construction; generated part nearly always
+    # matches at this scale — require >= 90% token agreement
+    agree = (got == base).mean()
+    assert agree >= 0.9, f"int8 cache diverged: {agree:.2f} agreement"
+
+
+def test_int8_cache_gpt2_dequantizes():
+    """Regression: every attention implementation must read the cache via
+    read_kv_cache — GPT-2's own attention once read raw int8 codes."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(vocab_size=128, n_embd=32, n_layer=2, n_head=4,
+                     n_positions=64)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.random.RandomState(11).randint(0, 128, (2, 10))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.asarray(ids))["params"]
+    eng = ds.init_inference(model, params=params, max_out_tokens=20)
+    base = np.asarray(eng.generate(ids, max_new_tokens=6, do_sample=False))
+    eng8 = ds.init_inference(model, params=params, max_out_tokens=20,
+                             kv_cache_int8=True)
+    got = np.asarray(eng8.generate(ids, max_new_tokens=6, do_sample=False))
+    agree = (got == base).mean()
+    assert agree >= 0.9, f"gpt2 int8 cache diverged: {agree:.2f}"
