@@ -47,19 +47,22 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
+    attn_impl = "pallas" if impl == "pallas_int8" else impl
+    kv_int8 = impl == "pallas_int8"
     if tiny:
-        cfg = LlamaConfig.tiny(remat=False, decode_attention_impl=impl)
+        cfg = LlamaConfig.tiny(remat=False, decode_attention_impl=attn_impl)
     else:
         cfg = LlamaConfig.llama_400m(
             max_position_embeddings=prompt + new, remat=False,
-            decode_attention_impl=impl)
+            decode_attention_impl=attn_impl)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  jax.numpy.asarray(ids[:1]))["params"]
     engine = ds.init_inference(model, params=params, dtype="bf16",
-                               max_out_tokens=prompt + new)
+                               max_out_tokens=prompt + new,
+                               kv_cache_int8=kv_int8)
 
     def best_of(fn, n=3):
         """min over repeats — single-shot timings at millisecond scale are
@@ -131,9 +134,10 @@ def main():
     ap.add_argument("--tiny", action="store_true", help="CPU smoke test")
     ap.add_argument("--one", nargs=3, type=int, metavar=("B", "P", "N"),
                     help="child mode: measure a single (batch,prompt,new) point")
-    ap.add_argument("--impl", default="xla", choices=("xla", "pallas"),
-                    help="decode attention: XLA repeat_kv path or the Pallas "
-                         "softmax_context-equivalent kernel")
+    ap.add_argument("--impl", default="xla", choices=("xla", "pallas", "pallas_int8"),
+                    help="decode attention: XLA repeat_kv path, the Pallas "
+                         "softmax_context-equivalent kernel, or the kernel "
+                         "over an int8 KV cache (half the cache bandwidth)")
     args = ap.parse_args()
 
     if args.one:

@@ -270,6 +270,7 @@ def main():
         # streamed per-point merge keeps finished points on an outer kill
         ("decode", None, 1500, f"DECODE_{t}.json"),          # merge-aware
         ("decode_pallas", None, 1500, f"DECODE_{t}_pallas.json"),
+        ("decode_pallas_int8", None, 1500, f"DECODE_{t}_pallas_int8.json"),
         ("kernels", None, None, f"KERNELS_{t}.json"),  # per-kernel splitter
         ("profile", [py, "tools/profile_train.py", "--quick"], 1200,
          f"PROFILE_{t}.json"),
@@ -305,7 +306,8 @@ def main():
         if name == "kernels":
             steps[name] = run_kernels_split(py, t, state)
         elif name.startswith("decode"):
-            impl = "pallas" if name == "decode_pallas" else "xla"
+            impl = {"decode": "xla", "decode_pallas": "pallas",
+                    "decode_pallas_int8": "pallas_int8"}[name]
             log(f"chip_sweep: {name} (cap {cap}s, merge-aware)")
             steps[name] = run_decode_merged(py, t, state, impl, cap)
         else:
