@@ -291,6 +291,10 @@ def main():
              "gas": 8},
             {"tag": "dots,m16xgas4,f512,lc2048", "policy": "dots", "batch": 16,
              "gas": 4, "lchunk": 2048},
+            # if the tunnel dispatch turns out fully synchronous even
+            # without fences, deeper gas is the only amortization left
+            {"tag": "dots,m8xgas32,f512,lc2048", "policy": "dots", "batch": 8,
+             "gas": 32, "lchunk": 2048},
             # xla-attention insurance: if Mosaic hangs or mis-tiles on this
             # chip, every flash candidate fails and the headline would read
             # null even with a healthy MXU; XLA attention at 1k is competitive
