@@ -58,6 +58,11 @@ class DeepSpeedInferenceConfig:
     #: scales, dequantized per block in VMEM by the Pallas decode kernel
     #: (models/layers.py init_kv_cache; reference int8 inference kernels)
     kv_cache_int8: bool = False
+    #: with quantize: dequantize weights INSIDE the decode loop (behind an
+    #: optimization barrier) so HBM streams int8 weights per step instead
+    #: of a hoisted bf16 copy — halves decode weight bandwidth for per-token
+    #: dequant compute. Off by default; measure per chip.
+    dequant_per_step: bool = False
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
 

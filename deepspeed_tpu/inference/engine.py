@@ -136,11 +136,16 @@ class InferenceEngine:
         dequant_meta = self._dequant_meta
         eos = eos_token_id if eos_token_id is not None else -1
 
-        def generate(params, input_ids, attention_mask, rng):
+        dequant_per_step = getattr(self.config, "dequant_per_step", False)
+
+        def generate(qparams, input_ids, attention_mask, rng):
             if dequant_meta is not None:
                 from ..compression.quantization import dequantize_params
 
-                params = dequantize_params(params, dequant_meta, compute_dtype)
+                params = dequantize_params(qparams, dequant_meta,
+                                           compute_dtype)
+            else:
+                params = qparams
             B, T = input_ids.shape
             cache = module.init_cache(
                 B, cache_len,
@@ -165,8 +170,23 @@ class InferenceEngine:
                 key_mask = jax.lax.dynamic_update_slice(
                     key_mask, jnp.ones((B, 1), jnp.int32), (0, cache_index))
                 pos = key_mask.sum(axis=-1, keepdims=True) - 1
+                if dequant_meta is not None and dequant_per_step:
+                    # re-dequantize INSIDE the decode loop behind an
+                    # optimization barrier: XLA cannot hoist it, so HBM
+                    # holds/streams int8 weights each step (half the
+                    # weight bandwidth — decode's other bottleneck beside
+                    # the cache) and the bf16 view is a fused temporary.
+                    # Opt-in: pays dequant VPU work per token.
+                    from ..compression.quantization import dequantize_params
+
+                    step_params = dequantize_params(
+                        jax.lax.optimization_barrier(qparams), dequant_meta,
+                        compute_dtype)
+                else:
+                    step_params = params
                 logits, cache = module.apply(
-                    {"params": params}, tok[:, None], attention_mask=key_mask,
+                    {"params": step_params}, tok[:, None],
+                    attention_mask=key_mask,
                     cache=cache, cache_index=cache_index, positions=pos)
                 nxt = _sample_logits(logits[:, 0], step_rng, do_sample, temperature,
                                      top_k, top_p).astype(tok.dtype)

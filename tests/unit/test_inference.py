@@ -620,3 +620,23 @@ def test_gemma_logits_and_generate_parity():
                           do_sample=False, pad_token_id=0).numpy()[:, 10:]
     got = np.asarray(engine.generate(ids, max_new_tokens=6, do_sample=False))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_int8_dequant_per_step_exact_match():
+    """dequant_per_step only moves WHERE dequantization happens (inside the
+    decode loop, behind an optimization barrier) — generated tokens must be
+    IDENTICAL to the hoisted-dequant int8 path."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    ids = np.random.RandomState(13).randint(0, cfg.vocab_size, (2, 8))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    base = ds.init_inference(model, params=params, dtype="int8",
+                             max_out_tokens=20)
+    per_step = ds.init_inference(model, params=params, dtype="int8",
+                                 max_out_tokens=20, dequant_per_step=True)
+    a = np.asarray(base.generate(ids, max_new_tokens=6, do_sample=False))
+    b = np.asarray(per_step.generate(ids, max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(a, b)
