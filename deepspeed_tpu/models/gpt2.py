@@ -33,6 +33,8 @@ class GPT2Config:
     attention_impl: str = "xla"
     scan_layers: bool = True
     remat: bool = False
+    #: >0: chunked training loss (models/layers.py); 0 = plain
+    loss_chunk: int = 0
 
     @staticmethod
     def gpt2_125m(**over):
@@ -163,6 +165,12 @@ class GPT2LMHeadModel(nn.Module):
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
         # weight-tied LM head (GPT-2 convention)
+        if cfg.loss_chunk and cache is None and labels is not None:
+            from .layers import chunked_cross_entropy_loss
+
+            return chunked_cross_entropy_loss(x, wte.embedding.T,
+                                              shift_labels(labels),
+                                              chunk=cfg.loss_chunk)
         logits = x @ wte.embedding.T.astype(x.dtype)
         if cache is not None:
             return logits, cache

@@ -281,18 +281,15 @@ class MixtralForCausalLM(nn.Module):
             hidden, aux, cache = out
         else:
             hidden, aux = out
-        if cfg.tie_word_embeddings:
-            embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
-            logits = hidden @ embed.T.astype(hidden.dtype)
-        else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                              param_dtype=jnp.float32)(hidden)
+        from .layers import lm_head_output
+
+        logits, lm = lm_head_output(self, cfg, hidden, labels, cache)
         if cache is not None:
             return logits, cache
         if labels is None:
             return logits
-        shifted = shift_labels(labels)
-        lm = cross_entropy_loss(logits, shifted)
+        if lm is None:
+            lm = cross_entropy_loss(logits, shift_labels(labels))
         return lm + cfg.router_aux_loss_coef * aux
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):

@@ -98,3 +98,38 @@ def test_generic_transformer_chunked_trains():
     # inference path (labels=None) still returns full logits
     logits = model.apply({"params": params}, ids)
     assert logits.shape == (2, 12, 97)
+
+
+def test_gpt2_chunked_parity():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    rs = np.random.RandomState(4)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 16)))
+    plain = GPT2LMHeadModel(GPT2Config.tiny())
+    chunk = GPT2LMHeadModel(GPT2Config.tiny(loss_chunk=8))
+    params = plain.init(jax.random.PRNGKey(0), ids)["params"]
+    l0, g0 = jax.value_and_grad(
+        lambda p: plain.apply({"params": p}, ids, labels=ids))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: chunk.apply({"params": p}, ids, labels=ids))(params)
+    assert np.allclose(l0, l1, rtol=1e-5, atol=1e-6)
+    g1 = dict(jax.tree_util.tree_leaves_with_path(g1))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g0):
+        assert np.allclose(leaf, g1[path], rtol=1e-4, atol=1e-5), path
+
+
+def test_mixtral_chunked_parity():
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    rs = np.random.RandomState(6)
+    kw = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=32,
+              num_local_experts=4, num_experts_per_tok=2)
+    ids = jnp.asarray(rs.randint(0, 128, (2, 16)))
+    plain = MixtralForCausalLM(MixtralConfig(**kw))
+    chunk = MixtralForCausalLM(MixtralConfig(**kw, loss_chunk=8))
+    params = plain.init(jax.random.PRNGKey(0), ids)["params"]
+    l0 = plain.apply({"params": params}, ids, labels=ids)
+    l1 = chunk.apply({"params": params}, ids, labels=ids)
+    assert np.allclose(l0, l1, rtol=1e-5, atol=1e-6)
