@@ -262,6 +262,13 @@ def update_kv_cache(layer_cache, k, v, cache_index):
     }
 
 
+def key_mask_to_bias(attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """[B, S] 1/0 key mask -> additive [B, 1, 1, S] bias (0 keep, -1e9 drop).
+    The ONE conversion used by every entry point that accepts a key mask."""
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                     -1e9).astype(jnp.float32)
+
+
 def cache_attention_bias(q_len: int, cache_len: int, cache_index,
                          key_mask: Optional[jnp.ndarray] = None,
                          window: Optional[int] = None) -> jnp.ndarray:

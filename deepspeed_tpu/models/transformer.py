@@ -15,7 +15,7 @@ encoder with its MLM head.
 """
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layers import (cache_attention_bias, cross_entropy_loss,
+                     key_mask_to_bias,
                      dot_product_attention, read_kv_cache,
                      lm_head_output,
                      init_kv_cache, repeat_kv, resolve_remat_policy,
@@ -75,6 +76,19 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "nothing"
+    #: dropout (BERT convention: on attention probs and on each sublayer
+    #: output pre-residual); active only when a caller passes
+    #: deterministic=False and provides a "dropout" rng
+    attn_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    #: compute dtype for the matmuls (None = flax promotion, i.e. fp32 with
+    #: fp32 params); layernorms always compute fp32
+    compute_dtype: Optional[Any] = None
+    #: kernel init: N(0, initializer_range) when set (BERT-style); flax
+    #: default (lecun_normal) when None. adjust_init_range additionally
+    #: scales the residual-output projections by 1/sqrt(2*num_hidden_layers)
+    initializer_range: Optional[float] = None
+    adjust_init_range: bool = False
     #: >0: training loss runs as a remat'd scan over token chunks of this
     #: size — the [tokens, vocab] logits tensor is never materialized
     #: (models/layers.py chunked_cross_entropy_loss). 0 = plain loss.
@@ -127,6 +141,18 @@ def alibi_bias(n_heads: int, kv_len: int) -> jnp.ndarray:
     return (slopes[:, None] * jnp.arange(kv_len)[None, :])[None, :, None, :]
 
 
+def _kernel_init(cfg, residual_out: bool):
+    """BERT-style N(0, initializer_range) when configured; residual-output
+    projections optionally scaled by 1/sqrt(2*L) (reference
+    adjust_init_range, ``transformer.py:74-78``)."""
+    if cfg.initializer_range is None:
+        return nn.linear.default_kernel_init
+    std = cfg.initializer_range
+    if residual_out and cfg.adjust_init_range:
+        std = std / float(np.sqrt(2.0 * max(1, cfg.num_hidden_layers)))
+    return nn.initializers.normal(stddev=std)
+
+
 def _act(name: str):
     return {
         "gelu": lambda x: nn.gelu(x, approximate=False),
@@ -159,13 +185,14 @@ class GenericAttention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None):
+    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None,
+                 deterministic=True):
         cfg = self.config
         B, T, _ = x.shape
         H, Hkv, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
-        dense = lambda feats, name, bias: nn.Dense(feats, use_bias=bias,
-                                                   name=name,
-                                                   param_dtype=jnp.float32)
+        dense = lambda feats, name, bias, out=False: nn.Dense(
+            feats, use_bias=bias, name=name, param_dtype=jnp.float32,
+            dtype=cfg.compute_dtype, kernel_init=_kernel_init(cfg, out))
         ab = cfg.attention_bias
         q = dense(H * D, "q_proj", ab)(x).reshape(B, T, H, D)
         k = dense(Hkv * D, "k_proj", ab)(x).reshape(B, T, Hkv, D)
@@ -198,12 +225,17 @@ class GenericAttention(nn.Module):
             # encoder (causal=False) relies on bias for padding; flash path
             # only fires for pure-causal no-bias configs
             impl = cfg.attention_impl if bias is None else "xla"
+            drng = self.make_rng("dropout") if (cfg.attn_dropout > 0 and
+                                                not deterministic) else None
             out = dot_product_attention(q, k, v, bias=bias, causal=cfg.causal,
                                         attention_impl=impl,
+                                        dropout_rng=drng,
+                                        dropout_rate=cfg.attn_dropout,
+                                        deterministic=deterministic,
                                         scale=cfg.attention_scale)
         out = out.reshape(B, T, H * D)
         ob = ab if cfg.attention_out_bias is None else cfg.attention_out_bias
-        return dense(cfg.hidden_size, "o_proj", ob)(out), layer_cache
+        return dense(cfg.hidden_size, "o_proj", ob, out=True)(out), layer_cache
 
 
 class GenericMLP(nn.Module):
@@ -213,44 +245,54 @@ class GenericMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         h = nn.Dense(cfg.intermediate_size, use_bias=cfg.mlp_bias, name="fc_in",
-                     param_dtype=jnp.float32)(x)
+                     param_dtype=jnp.float32, dtype=cfg.compute_dtype,
+                     kernel_init=_kernel_init(cfg, False))(x)
         h = _act(cfg.activation)(h)
         return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="fc_out",
-                        param_dtype=jnp.float32)(h)
+                        param_dtype=jnp.float32, dtype=cfg.compute_dtype,
+                        kernel_init=_kernel_init(cfg, True))(h)
 
 
 class TransformerBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None):
+    def __call__(self, x, cos, sin, bias, layer_cache=None, cache_index=None,
+                 deterministic=True):
         cfg = self.config
         ln = lambda name: nn.LayerNorm(epsilon=cfg.norm_eps, name=name,
                                        param_dtype=jnp.float32)
         attn = GenericAttention(cfg, name="attn")
         mlp = GenericMLP(cfg, name="mlp")
+        # BERT convention: dropout each sublayer output pre-residual
+        drop = lambda y: nn.Dropout(cfg.hidden_dropout)(
+            y, deterministic=deterministic or cfg.hidden_dropout == 0)
         if cfg.parallel_residual:
             # NeoX: both branches read the SAME input, residual-summed once;
             # GPT-J shares ONE LayerNorm between the branches
             h = ln("ln_attn")(x)
-            a, layer_cache = attn(h, cos, sin, bias, layer_cache, cache_index)
+            a, layer_cache = attn(h, cos, sin, bias, layer_cache, cache_index,
+                                  deterministic)
             m = mlp(h if cfg.shared_parallel_ln else ln("ln_mlp")(x))
-            x = x + a + m
+            x = x + drop(a) + drop(m)
         elif cfg.pre_layernorm:
             a, layer_cache = attn(ln("ln_attn")(x), cos, sin, bias,
-                                  layer_cache, cache_index)
-            x = x + a
-            x = x + mlp(ln("ln_mlp")(x))
+                                  layer_cache, cache_index, deterministic)
+            x = x + drop(a)
+            x = x + drop(mlp(ln("ln_mlp")(x)))
         else:
             # post-LN (BERT, OPT-350m)
-            a, layer_cache = attn(x, cos, sin, bias, layer_cache, cache_index)
-            x = ln("ln_attn")(x + a)
-            x = ln("ln_mlp")(x + mlp(x))
+            a, layer_cache = attn(x, cos, sin, bias, layer_cache, cache_index,
+                                  deterministic)
+            x = ln("ln_attn")(x + drop(a))
+            x = ln("ln_mlp")(x + drop(mlp(x)))
         return x, layer_cache
 
 
 class _ScanBlock(nn.Module):
     config: TransformerConfig
+    deterministic: bool = True  # trace-static; an attribute, NOT a carry
+    # leaf (a carried bool would be traced and break python short-circuits)
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -262,7 +304,8 @@ class _ScanBlock(nn.Module):
             # (carry keeps the PAIR so the scan structure stays invariant)
             layer_bias = jnp.where(local_sel, bias[1], bias[0])
         x, layer_cache = TransformerBlock(self.config, name="block")(
-            x, cos, sin, layer_bias, layer_cache, cache_index)
+            x, cos, sin, layer_bias, layer_cache, cache_index,
+            self.deterministic)
         return (x, cos, sin, bias, cache_index), layer_cache
 
 
@@ -322,8 +365,7 @@ class TransformerModel(nn.Module):
                 bias = cache_attention_bias(T, kv_len, cache_index,
                                             key_mask=key_mask)
         elif attention_mask is not None:
-            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
-                             -1e9).astype(jnp.float32)
+            bias = key_mask_to_bias(attention_mask)
         if cfg.pos_embedding == "alibi":
             ab = alibi_bias(cfg.num_attention_heads, kv_len)
             bias = ab if bias is None else bias + ab
@@ -361,13 +403,13 @@ class TransformerModel(nn.Module):
                 block_cls = nn.remat(_ScanBlock, prevent_cse=False,
                                      policy=resolve_remat_policy(cfg.remat_policy))
             scan = nn.scan(block_cls, variable_axes={"params": 0},
-                           split_rngs={"params": True},
+                           split_rngs={"params": True, "dropout": True},
                            length=cfg.num_hidden_layers, metadata_params={})
-            (x, *_), cache = scan(cfg, name="layers")(
+            (x, *_), cache = scan(cfg, deterministic, name="layers")(
                 (x, cos, sin, bias, cache_index), (cache, local_sel))
         else:
             block_cls = nn.remat(
-                TransformerBlock, prevent_cse=False,
+                TransformerBlock, prevent_cse=False, static_argnums=(7,),
                 policy=resolve_remat_policy(cfg.remat_policy)) \
                 if (cfg.remat and cache is None) else TransformerBlock
             new_cache = [] if cache is not None else None
@@ -377,7 +419,8 @@ class TransformerModel(nn.Module):
                 lbias = bias if kinds is None else \
                     (bias[1] if kinds[i] == "local" else bias[0])
                 x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
-                    x, cos, sin, lbias, layer_cache, cache_index)
+                    x, cos, sin, lbias, layer_cache, cache_index,
+                    deterministic)
                 if new_cache is not None:
                     new_cache.append(layer_cache)
             if new_cache is not None:

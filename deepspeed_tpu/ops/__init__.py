@@ -1,1 +1,3 @@
 from .optimizers import FusedAdam, FusedLamb, DeepSpeedCPUAdam, get_optimizer  # noqa: F401
+from .transformer import (DeepSpeedTransformerConfig,  # noqa: F401
+                          DeepSpeedTransformerLayer)
