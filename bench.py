@@ -29,9 +29,15 @@ import subprocess
 import sys
 import time
 
-# Persistent compilation cache: first compile over the tunneled TPU can take
-# minutes; cached reruns start in seconds.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/deepspeed_tpu_jax_bench_cache")
+def _enable_compile_cache():
+    """Persistent compilation cache: first compile over the tunneled TPU can
+    take minutes; cached reruns start in seconds. Called from the SCRIPT
+    entry only — importing bench as a library must not mutate the
+    environment (a leaked JAX_COMPILATION_CACHE_DIR makes XLA:CPU child
+    processes load machine-mismatched AOT artifacts and SIGABRT in the
+    collective thunk executor)."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/deepspeed_tpu_jax_bench_cache")
 
 BASELINE_TFLOPS = 157.0  # reference ZeRO-3 headline (A100)
 SEQ = 1024
@@ -388,6 +394,7 @@ def main():
 
 
 if __name__ == "__main__":
+    _enable_compile_cache()
     if len(sys.argv) >= 3 and sys.argv[1] == "--candidate":
         if os.environ.get("DS_BENCH_TINY"):
             import jax

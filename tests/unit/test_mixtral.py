@@ -148,7 +148,15 @@ def test_sliding_window_logits_parity():
 
 
 _REPLICATE_TOKENS_SCRIPT = r"""
+import os
+# a leaked compile-cache dir makes this multi-device CPU child SIGABRT in
+# the collective thunk executor (seen when a sibling test imported
+# bench.py, which used to setdefault the env var at import). sitecustomize
+# pre-imports jax, so the env var is already absorbed into jax.config —
+# clear it THERE, not in os.environ.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 import jax
+jax.config.update("jax_compilation_cache_dir", None)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np
