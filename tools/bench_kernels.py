@@ -173,6 +173,26 @@ def main():
 
     run("decode_attention", decode)
 
+    # ---- decode attention over an int8 KV cache ----------------------
+    from deepspeed_tpu.models.layers import _quantize_kv, dequantize_kv
+
+    def decode_int8():
+        kq, ks = _quantize_kv(kc)
+        vq, vs = _quantize_kv(vc)
+        pal = jax.jit(lambda a, b, c, bs, cs: decode_attention(
+            a, b, c, cidx, key_mask=kmask, k_scale=bs, v_scale=cs,
+            force_pallas=True))
+        xla = jax.jit(lambda a, b, c, bs, cs: _reference_decode(
+            a, dequantize_kv(b, bs), dequantize_kv(c, cs), cidx, kmask,
+            1.0 / D ** 0.5))
+        got = pal(qd, kq, vq, ks, vs)
+        ref = xla(qd, kq, vq, ks, vs)
+        return _record("decode_attention_int8", mode, ref, got,
+                       _timeit(pal, qd, kq, vq, ks, vs),
+                       _timeit(xla, qd, kq, vq, ks, vs), 2e-3)
+
+    run("decode_attention_int8", decode_int8)
+
     # ---- fused Adam / LAMB -------------------------------------------
     import optax
 

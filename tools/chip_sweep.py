@@ -31,7 +31,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 KERNEL_NAMES = ["flash_fwd", "flash_bwd_dq", "block_sparse_fwd",
-                "decode_attention", "fused_adam", "fused_lamb"]
+                "decode_attention", "decode_attention_int8", "fused_adam",
+                "fused_lamb"]
 
 PROBE = ("import json, time\nt0=time.time()\nimport jax\n"
          "d=jax.devices()\nprint(json.dumps({'n': len(d), "
