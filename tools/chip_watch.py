@@ -67,9 +67,18 @@ def main():
             with open(log_path, "a") as f:
                 f.write(f"{stamp} sweep fired, rc={rc}\n")
             if rc == 0:
-                print("chip_watch: sweep complete", file=sys.stderr,
-                      flush=True)
-                return
+                print("chip_watch: sweep complete -> attacking the headline",
+                      file=sys.stderr, flush=True)
+                # the artifact set is safe; spend every further window
+                # driving the MFU number up (resumable coordinate descent)
+                arc = subprocess.call(
+                    [py, os.path.join(REPO, "tools", "attack_mfu.py"),
+                     "--tag", args.tag, "--budget_s", "3600"])
+                with open(log_path, "a") as f:
+                    f.write(f"{stamp} attack fired, rc={arc}\n")
+                if arc == 0:
+                    print("chip_watch: attack budget spent; watching for "
+                          "more windows", file=sys.stderr, flush=True)
         time.sleep(args.interval_s)
 
 
