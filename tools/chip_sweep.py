@@ -278,6 +278,10 @@ def main():
         ("infinity", [py, "tools/bench_infinity.py"], 900,
          f"INFINITY_{t}_chip.json"),
         ("longctx", [py, "tools/bench_longctx.py"], 1200, f"LONGCTX_{t}.json"),
+        # the reference's OTHER kernel headline: BERT-Large layer TFLOPs
+        # (64 TFLOPS seq128 / 53 seq512 on V100) vs our ops.transformer layer
+        ("bert_layer", [py, "tools/bench_bert_layer.py"], 900,
+         f"BERT_{t}.json"),
     ]
     if steps.get("bench", {}).get("ok"):
         # the captured bench predates THIS sweep process (resume from an
