@@ -1,0 +1,70 @@
+"""3D-parallel Llama training in ~60 lines — the reference's flagship recipe.
+
+Mirrors the Megatron-DeepSpeed tutorial shape (ZeRO + tensor parallel +
+data parallel from one JSON config). Runs anywhere:
+
+    # laptop / CI: virtual 8-device CPU mesh
+    python examples/train_llama_3d.py --cpu_devices 8
+
+    # real TPU slice: drop the flag; the mesh uses every visible chip
+    python examples/train_llama_3d.py --steps 50
+
+Config knobs live in the ds_config dict exactly where a DeepSpeed user
+expects them (`train_batch_size`, `zero_optimization`, `bf16`, `parallel`).
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu_devices", type=int, default=0,
+                    help=">0: run on a virtual CPU mesh of this many devices")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--model_parallel", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=688,
+                      num_hidden_layers=4, num_attention_heads=8,
+                      num_key_value_heads=4, max_position_embeddings=256,
+                      remat=True, remat_policy="dots", loss_chunk=512)
+    model = LlamaForCausalLM(cfg)
+
+    n_dev = len(jax.devices())
+    ds_config = {
+        "train_batch_size": n_dev // args.model_parallel * 2,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 3},
+        "parallel": {"data": -1, "model": args.model_parallel},
+        "steps_per_print": 10,
+    }
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (ds_config["train_batch_size"], 256))
+    engine, _, _, _ = ds.initialize(
+        model=model, config=ds_config,
+        example_batch={"input_ids": ids[:1], "labels": ids[:1]},
+        partition_rules=LlamaForCausalLM.partition_rules(cfg))
+
+    for step in range(args.steps):
+        loss = engine.train_batch(batch={"input_ids": ids, "labels": ids})
+    print(f"final loss after {args.steps} steps: {float(loss):.4f} "
+          f"(dp={n_dev // args.model_parallel} x tp={args.model_parallel} "
+          f"x zero3)")
+
+
+if __name__ == "__main__":
+    main()
