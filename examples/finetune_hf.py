@@ -8,8 +8,12 @@ train the converted params with the fused-jit engine.
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -35,7 +39,7 @@ def main():
     rs = np.random.RandomState(0)
     ids = rs.randint(0, 512, (8, 64))
     engine, _, _, _ = ds.initialize(
-        model=model, params=params,
+        model=model, model_parameters=params,
         config={
             "train_batch_size": 8,
             "optimizer": {"type": "AdamW", "params": {"lr": 5e-4}},

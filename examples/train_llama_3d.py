@@ -14,8 +14,12 @@ expects them (`train_batch_size`, `zero_optimization`, `bf16`, `parallel`).
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
