@@ -640,3 +640,26 @@ def test_int8_dequant_per_step_exact_match():
     a = np.asarray(base.generate(ids, max_new_tokens=6, do_sample=False))
     b = np.asarray(per_step.generate(ids, max_new_tokens=6, do_sample=False))
     np.testing.assert_array_equal(a, b)
+
+
+def test_int8_kv_cache_composes_with_tensor_parallel():
+    """kv_cache_int8 under mp_size=4: scales [B,S,Hkv] shard with the cache
+    over the head axis; greedy tokens must match the single-device int8-cache
+    run exactly (quantization noise is identical — same values)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.parallel import build_mesh
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                                       (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    e1 = ds.init_inference(model, params=params, dtype="fp32",
+                           kv_cache_int8=True, mesh=build_mesh(data=8))
+    out1 = np.asarray(e1.generate(ids, max_new_tokens=5))
+    e2 = ds.init_inference(model, params=params, dtype="fp32",
+                           kv_cache_int8=True, mp_size=4,
+                           mesh=build_mesh(data=2, model=4))
+    out2 = np.asarray(e2.generate(ids, max_new_tokens=5))
+    np.testing.assert_array_equal(out1, out2)
