@@ -54,7 +54,8 @@ init_distributed = comm.init_distributed
 
 
 _LAZY_MODULES = {"zero": ".runtime.zero", "moe": ".moe", "ops": ".ops",
-                 "pipe": ".pipe", "module_inject": ".module_inject"}
+                 "pipe": ".pipe", "module_inject": ".module_inject",
+                 "checkpointing": ".checkpointing"}
 _LAZY_NAMES = {
     "DeepSpeedEngine": (".runtime.engine", "DeepSpeedEngine"),
     "PipelineEngine": (".pipe.engine", "PipelineEngine"),

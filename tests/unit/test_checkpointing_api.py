@@ -1,0 +1,84 @@
+"""deepspeed.checkpointing facade parity (reference
+``runtime/activation_checkpointing/checkpointing.py:743,:825``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    ds.checkpointing.reset()
+    yield
+    ds.checkpointing.reset()
+
+
+def _block(w, x):
+    h = jnp.tanh(x @ w)
+    return jnp.sum(h * h)
+
+
+def test_checkpoint_matches_direct_value_and_grad():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(16, 16), jnp.float32)
+    x = jnp.asarray(rs.randn(4, 16), jnp.float32)
+    direct_v, direct_g = jax.value_and_grad(_block)(w, x)
+    ck_v, ck_g = jax.value_and_grad(
+        lambda w, x: ds.checkpointing.checkpoint(_block, w, x))(w, x)
+    np.testing.assert_allclose(np.asarray(direct_v), np.asarray(ck_v),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(direct_g), np.asarray(ck_g),
+                               rtol=1e-6)
+
+
+def test_checkpoint_actually_remats():
+    w = jnp.ones((8, 8), jnp.float32)
+    x = jnp.ones((2, 8), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(jax.grad(
+        lambda w: ds.checkpointing.checkpoint(_block, w, x)))(w))
+    assert "remat" in jaxpr  # the backward recomputes the block
+
+
+def test_configure_from_ds_config_maps_cpu_checkpointing():
+    ds.checkpointing.configure(deepspeed_config={
+        "activation_checkpointing": {"cpu_checkpointing": True,
+                                     "profile": True,
+                                     "number_checkpoints": 4}})
+    assert ds.checkpointing.is_configured()
+    assert ds.checkpointing._config["policy"] == "offload_dots_no_batch"
+    assert ds.checkpointing._config["profile"] is True
+    assert ds.checkpointing._config["num_checkpoints"] == 4
+    # profile path still computes correctly
+    w = jnp.ones((8, 8), jnp.float32)
+    x = jnp.ones((2, 8), jnp.float32)
+    v = ds.checkpointing.checkpoint(_block, w, x)
+    assert np.isfinite(float(v))
+
+
+def test_rng_tracker_parity_surface():
+    ds.checkpointing.model_parallel_cuda_manual_seed(1234)
+    assert ds.checkpointing.get_rng_state()["seed"] == 1234
+    tracker = ds.checkpointing.get_cuda_rng_tracker()
+    tracker.add("model-parallel-rng", 7)
+    with tracker.fork():
+        pass
+    assert tracker.get_states()["model-parallel-rng"] == 7
+
+
+def test_repeated_configure_refines_never_resets():
+    ds.checkpointing.configure(deepspeed_config={
+        "activation_checkpointing": {"cpu_checkpointing": True}})
+    ds.checkpointing.configure(num_checkpoints=8)  # must not revert policy
+    assert ds.checkpointing._config["policy"] == "offload_dots_no_batch"
+    assert ds.checkpointing._config["num_checkpoints"] == 8
+
+
+def test_manual_seed_registers_in_tracker_and_reset():
+    ds.checkpointing.model_parallel_cuda_manual_seed(99)
+    tracker = ds.checkpointing.get_cuda_rng_tracker()
+    assert tracker.get_states()["model-parallel-rng"] == 99
+    tracker.reset()
+    assert tracker.get_states() == {}
