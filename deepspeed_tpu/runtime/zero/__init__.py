@@ -7,3 +7,11 @@ from .partition import (  # noqa: F401
     state_shardings,
 )
 from .tiling import TiledLinear, split_tensor_along_last_dim  # noqa: F401
+from .estimator import (  # noqa: F401
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero2_model_states_mem_needs_all_cold,
+    estimate_zero2_model_states_mem_needs_all_live,
+    estimate_zero3_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs_all_cold,
+    estimate_zero3_model_states_mem_needs_all_live,
+)

@@ -1,0 +1,193 @@
+"""ZeRO memory estimators — the pre-training sizing helpers.
+
+Reference: ``runtime/zero/stage3.py:2408-2530`` and
+``stage_1_and_2.py`` expose ``estimate_zero{2,3}_model_states_mem_needs*``
+functions users run BEFORE training to size a cluster; they print a table
+of per-device / per-host memory for each offload configuration.
+
+TPU semantics: "gpu" columns are HBM per chip, "cpu" columns are host RAM
+per process. The byte accounting follows this engine's actual precision
+stack (bf16 compute params + fp32 masters + 2 fp32 Adam moments = 18
+bytes/param of model states, the same total as the reference's fp16
+stack), sharded the way each stage shards:
+
+- stage 3: all model states sharded over every chip; ``zero_init``
+  mirrors ``zero.Init``/born-sharded init (params never fully replicated
+  on one device at birth — the default here, see engine born-sharded
+  init).
+- stage 2 (and 1): optimizer states sharded, bf16 params + grads
+  replicated per chip.
+- ``cpu_offload`` moves masters+moments to host (HostOffloadOptimizer);
+  ``cpu_offload_params`` additionally streams the bf16 body from host
+  (ZeRO-Infinity, ``runtime/zero/infinity.py``) so HBM holds only the
+  largest streamed block plus edges.
+
+Functions mirror the reference names; ``*_all_live`` takes a flax module
++ example batch (shapes derived via ``jax.eval_shape`` — nothing is
+allocated), ``*_all_cold`` takes explicit counts.
+"""
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _fmt(nbytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(nbytes) < 1024 or unit == "TB":
+            return f"{nbytes / 1 :.2f}{unit}" if unit == "B" \
+                else f"{nbytes:.2f}{unit}"
+        nbytes /= 1024.0
+    return f"{nbytes:.2f}TB"
+
+
+def _model_counts(model, example_batch=None, rng=None):
+    """(total_params, largest_layer_params) via eval_shape — allocates
+    nothing (the reference iterates live torch params; flax modules are
+    functional, so shapes come from abstract init)."""
+    import jax
+
+    if example_batch is None:
+        raise ValueError("provide example_batch to derive shapes "
+                         "(abstract init needs input structure)")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    kwargs = dict(example_batch)
+    shapes = jax.eval_shape(lambda: model.init(rng, **kwargs))
+    leaves = jax.tree_util.tree_leaves(shapes)
+    sizes = [int(np.prod(x.shape)) for x in leaves]
+    return sum(sizes), max(sizes) if sizes else 0
+
+
+def estimate_zero3_model_states_mem_needs(
+        total_params: int, largest_layer_params: int,
+        num_gpus_per_node: int = 1, num_nodes: int = 1,
+        cpu_offload: bool = True, cpu_offload_params: bool = True,
+        zero_init: bool = True, additional_buffer_factor: float = 1.5):
+    """Per-(chip, host) bytes for one ZeRO-3 configuration (no printing).
+    Byte model: 2 (bf16 param) + 2 (bf16 grad) + 4 (fp32 master) + 8
+    (Adam moments) + 2 (master-update staging) = 18 B/param of model
+    states, matching the reference's totals."""
+    total_chips = num_nodes * num_gpus_per_node
+    node_factor = 1 / num_nodes
+    largest_layer_memory = 4 * largest_layer_params  # bf16 params+grads x2
+    if cpu_offload:
+        if cpu_offload_params:
+            # ZeRO-Infinity param streaming: HBM holds the largest block
+            hbm = largest_layer_memory
+            if zero_init:
+                host = total_params * 18 * node_factor \
+                    * additional_buffer_factor
+            else:
+                host = total_params * max(4 * num_gpus_per_node,
+                                          18 * node_factor) \
+                    * additional_buffer_factor
+        else:
+            hbm = largest_layer_memory + 2 * total_params // total_chips
+            if zero_init:
+                host = total_params * 16 * node_factor \
+                    * additional_buffer_factor
+            else:
+                host = total_params * max(4 * num_gpus_per_node,
+                                          16 * node_factor) \
+                    * additional_buffer_factor
+    else:
+        hbm = largest_layer_memory + 18 * total_params // total_chips
+        if zero_init:
+            host = largest_layer_params * 4 * num_gpus_per_node \
+                * additional_buffer_factor
+        else:
+            host = total_params * 4 * num_gpus_per_node \
+                * additional_buffer_factor
+    return int(hbm), int(host), largest_layer_memory
+
+
+def _print_table3(total_params, largest_layer_params, num_gpus_per_node,
+                  num_nodes, additional_buffer_factor):
+    total = num_nodes * num_gpus_per_node
+    print(f"Estimated memory needed for params, optim states and gradients "
+          f"for a:\nHW: Setup with {num_nodes} node{'s'[:num_nodes > 1]}, "
+          f"{num_gpus_per_node} chip{'s'[:num_gpus_per_node > 1]} per node"
+          f" ({total} total).\nSW: Model with "
+          f"{int(total_params / 1e6)}M total params, "
+          f"{int(largest_layer_params / 1e6)}M largest layer params.")
+    print("  per host  |  per chip |   Options")
+    for co, cop, zi in ((True, True, True), (True, True, False),
+                        (True, False, True), (True, False, False),
+                        (False, False, True), (False, False, False)):
+        hbm, host, _ = estimate_zero3_model_states_mem_needs(
+            total_params, largest_layer_params, num_gpus_per_node,
+            num_nodes, cpu_offload=co, cpu_offload_params=cop, zero_init=zi,
+            additional_buffer_factor=additional_buffer_factor)
+        print(f"  {_fmt(host):>9} | {_fmt(hbm):>9} | "
+              f"offload_param={'cpu' if cop else 'none'}, "
+              f"offload_optimizer={'cpu' if co else 'none'}, "
+              f"zero_init={int(zi)}")
+
+
+def estimate_zero3_model_states_mem_needs_all_live(
+        model, num_gpus_per_node: int = 1, num_nodes: int = 1,
+        additional_buffer_factor: float = 1.5, example_batch=None, rng=None):
+    """Reference ``stage3.py:2464``: derive counts from a live (flax) model
+    and print the configuration table."""
+    total, largest = _model_counts(model, example_batch, rng)
+    _print_table3(total, largest, num_gpus_per_node, num_nodes,
+                  additional_buffer_factor)
+
+
+def estimate_zero3_model_states_mem_needs_all_cold(
+        total_params: int, largest_layer_params: int,
+        num_gpus_per_node: int = 1, num_nodes: int = 1,
+        additional_buffer_factor: float = 1.5):
+    """Reference ``stage3.py:2498``: hypothetical-model variant."""
+    _print_table3(total_params, largest_layer_params, num_gpus_per_node,
+                  num_nodes, additional_buffer_factor)
+
+
+def estimate_zero2_model_states_mem_needs(
+        total_params: int, num_gpus_per_node: int = 1, num_nodes: int = 1,
+        cpu_offload: bool = True, additional_buffer_factor: float = 1.5):
+    """Stage 1/2: optimizer states sharded; bf16 params + grads replicated
+    per chip (4 B/param HBM)."""
+    total_chips = num_nodes * num_gpus_per_node
+    node_factor = 1 / num_nodes
+    if cpu_offload:
+        hbm = 4 * total_params
+        host = total_params * max(4 * num_gpus_per_node, 14 * node_factor) \
+            * additional_buffer_factor
+    else:
+        hbm = 4 * total_params + 14 * total_params // total_chips
+        host = total_params * 4 * num_gpus_per_node \
+            * additional_buffer_factor
+    return int(hbm), int(host)
+
+
+def _print_table2(total_params, num_gpus_per_node, num_nodes,
+                  additional_buffer_factor):
+    total = num_nodes * num_gpus_per_node
+    print(f"Estimated memory needed for params, optim states and gradients "
+          f"for a:\nHW: Setup with {num_nodes} node{'s'[:num_nodes > 1]}, "
+          f"{num_gpus_per_node} chip{'s'[:num_gpus_per_node > 1]} per node"
+          f" ({total} total).\nSW: Model with "
+          f"{int(total_params / 1e6)}M total params.")
+    print("  per host  |  per chip |   Options")
+    for co in (True, False):
+        hbm, host = estimate_zero2_model_states_mem_needs(
+            total_params, num_gpus_per_node, num_nodes, cpu_offload=co,
+            additional_buffer_factor=additional_buffer_factor)
+        print(f"  {_fmt(host):>9} | {_fmt(hbm):>9} | "
+              f"offload_optimizer={'cpu' if co else 'none'}")
+
+
+def estimate_zero2_model_states_mem_needs_all_live(
+        model, num_gpus_per_node: int = 1, num_nodes: int = 1,
+        additional_buffer_factor: float = 1.5, example_batch=None, rng=None):
+    total, _ = _model_counts(model, example_batch, rng)
+    _print_table2(total, num_gpus_per_node, num_nodes,
+                  additional_buffer_factor)
+
+
+def estimate_zero2_model_states_mem_needs_all_cold(
+        total_params: int, num_gpus_per_node: int = 1, num_nodes: int = 1,
+        additional_buffer_factor: float = 1.5):
+    _print_table2(total_params, num_gpus_per_node, num_nodes,
+                  additional_buffer_factor)

@@ -1,0 +1,56 @@
+"""ZeRO memory estimators (reference stage3.py:2408-2530 user API)."""
+
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.zero import (
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs_all_cold,
+    estimate_zero3_model_states_mem_needs_all_live)
+
+
+def test_zero3_scaling_with_world_size():
+    n, ll = 1_000_000_000, 50_000_000
+    hbm1, host1, _ = estimate_zero3_model_states_mem_needs(
+        n, ll, num_gpus_per_node=8, num_nodes=1,
+        cpu_offload=False, cpu_offload_params=False)
+    hbm2, host2, _ = estimate_zero3_model_states_mem_needs(
+        n, ll, num_gpus_per_node=8, num_nodes=2,
+        cpu_offload=False, cpu_offload_params=False)
+    assert hbm2 < hbm1            # model states shard over more chips
+    # infinity mode: HBM independent of model size (largest block only)
+    hbm_inf, host_inf, _ = estimate_zero3_model_states_mem_needs(
+        n, ll, cpu_offload=True, cpu_offload_params=True)
+    assert hbm_inf == 4 * ll
+    assert host_inf > 18 * n      # buffered host residency
+
+
+def test_zero2_offload_moves_optimizer_off_chip():
+    n = 100_000_000
+    hbm_off, _ = estimate_zero2_model_states_mem_needs(n, cpu_offload=True)
+    hbm_on, _ = estimate_zero2_model_states_mem_needs(n, cpu_offload=False)
+    assert hbm_off == 4 * n
+    assert hbm_on > hbm_off
+
+
+def test_all_cold_prints_table(capsys):
+    estimate_zero3_model_states_mem_needs_all_cold(
+        1_000_000_000, 50_000_000, num_gpus_per_node=8, num_nodes=2)
+    out = capsys.readouterr().out
+    assert "per chip" in out and "offload_param=cpu" in out
+    assert out.count("\n") >= 8   # header + 6 config rows
+
+
+def test_all_live_derives_counts_without_allocating(capsys):
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    estimate_zero3_model_states_mem_needs_all_live(
+        model, num_gpus_per_node=8, example_batch={"input_ids": ids})
+    out = capsys.readouterr().out
+    assert "total params" in out and "largest layer" in out
