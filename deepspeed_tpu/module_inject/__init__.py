@@ -1,3 +1,4 @@
-from .replace_module import replace_transformer_layer  # noqa: F401
+from .replace_module import (replace_transformer_layer,  # noqa: F401
+                             revert_transformer_layer)
 from .replace_policy import (HFGPT2LayerPolicy, HFLlamaLayerPolicy,  # noqa: F401
                              generic_policies, match_policy)

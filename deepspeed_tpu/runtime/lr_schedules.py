@@ -134,3 +134,68 @@ def get_lr_schedule(name: Optional[str], params: Dict[str, Any],
     if name not in SCHEDULE_REGISTRY:
         raise ValueError(f"Unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
     return SCHEDULE_REGISTRY[name](**params)
+
+
+def add_tuning_arguments(parser):
+    """Reference ``lr_schedules.py:55``: attach the convergence-tuning CLI
+    group (schedule selection + per-schedule knobs) to an argparse parser.
+    The flags mirror the reference names and feed the same four schedule
+    classes above via ``get_lr_scheduler_from_args``."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training "
+                            "(WarmupLR|WarmupDecayLR|OneCycle|LRRangeTest)")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_momentum", type=bool, default=True)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0.0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log",
+                       help="'log' or 'linear'")
+    return parser
+
+
+def get_lr_scheduler_from_args(args):
+    """Build a schedule instance from ``add_tuning_arguments`` flags."""
+    name = getattr(args, "lr_schedule", None)
+    if not name:
+        return None
+    if name == "WarmupLR":
+        return WarmupLR(warmup_min_lr=args.warmup_min_lr,
+                        warmup_max_lr=args.warmup_max_lr,
+                        warmup_num_steps=args.warmup_num_steps,
+                        warmup_type=args.warmup_type)
+    if name == "WarmupDecayLR":
+        return WarmupDecayLR(total_num_steps=getattr(
+                                 args, "total_num_steps", 10 * args.warmup_num_steps),
+                             warmup_min_lr=args.warmup_min_lr,
+                             warmup_max_lr=args.warmup_max_lr,
+                             warmup_num_steps=args.warmup_num_steps,
+                             warmup_type=args.warmup_type)
+    if name == "OneCycle":
+        return OneCycle(cycle_min_lr=args.cycle_min_lr,
+                        cycle_max_lr=args.cycle_max_lr,
+                        cycle_first_step_size=args.cycle_first_step_size,
+                        decay_lr_rate=args.decay_lr_rate,
+                        decay_step_size=args.decay_step_size)
+    if name == "LRRangeTest":
+        return LRRangeTest(lr_range_test_min_lr=args.lr_range_test_min_lr,
+                           lr_range_test_step_rate=args.lr_range_test_step_rate,
+                           lr_range_test_step_size=args.lr_range_test_step_size,
+                           lr_range_test_staircase=args.lr_range_test_staircase)
+    raise ValueError(f"unknown lr_schedule {name!r}")

@@ -1,2 +1,3 @@
 from .logging import log_dist, logger  # noqa: F401
 from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401
+from .init_on_device import OnDevice  # noqa: F401

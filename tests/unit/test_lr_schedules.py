@@ -55,3 +55,23 @@ def test_registry():
     with pytest.raises(ValueError):
         get_lr_schedule("Nope", {})
     assert get_lr_schedule(None, {}) is None
+
+
+def test_add_tuning_arguments_roundtrip():
+    """Reference lr_schedules.py:55 CLI surface builds working schedules."""
+    import argparse
+
+    from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments,
+                                                    get_lr_scheduler_from_args)
+
+    p = argparse.ArgumentParser()
+    add_tuning_arguments(p)
+    a = p.parse_args(["--lr_schedule", "WarmupLR", "--warmup_num_steps", "10",
+                      "--warmup_max_lr", "0.01", "--warmup_type", "linear"])
+    sched = get_lr_scheduler_from_args(a)
+    assert abs(float(sched(10)) - 0.01) < 1e-9
+    assert float(sched(5)) < 0.01
+    a2 = p.parse_args(["--lr_schedule", "OneCycle", "--cycle_min_lr", "0.001",
+                       "--cycle_max_lr", "0.1"])
+    assert get_lr_scheduler_from_args(a2) is not None
+    assert get_lr_scheduler_from_args(p.parse_args([])) is None
