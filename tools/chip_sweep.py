@@ -285,13 +285,15 @@ def main():
     ]
     if steps.get("bench", {}).get("ok"):
         # the captured bench predates THIS sweep process (resume from an
-        # earlier window): re-run the ladder right after diag — the headline
-        # is the verdict's #1 item and window 1's 27.14 winner predates the
-        # per-step-fence fix and the gas-scan candidates. Budget 900s (not
-        # the full 1500s default) so a ~12-min window still reaches decode.
-        # On a fresh sweep the first bench step already runs the current
-        # ladder. Named bench_v2 so `--skip bench` (prefix match) covers it.
-        plan.insert(2, ("bench_v2",
+        # earlier window): re-run the ladder FIRST — the headline is the
+        # verdict's #1 item and window 1's 27.14 winner predates the
+        # per-step-fence fix and the gas-scan candidates (whose gas-vs-plain
+        # ratio doubles as the dispatch-cost diagnosis if the window dies
+        # before diag). Budget 900s (not the full 1500s default) so a
+        # ~12-min window still reaches the next steps. On a fresh sweep the
+        # first bench step already runs the current ladder. Named bench_v2
+        # so `--skip bench` (prefix match) covers it.
+        plan.insert(1, ("bench_v2",
                         ["env", "DS_BENCH_BUDGET_S=900", py, "bench.py"],
                         1100, f"BENCH_{t}_v2.json"))
     backend_lost = False
