@@ -29,7 +29,7 @@ for parity rather than device RNG state.
 """
 
 import time
-from typing import Any, Optional
+from typing import Any
 
 import jax
 

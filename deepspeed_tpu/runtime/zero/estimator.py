@@ -27,18 +27,14 @@ Functions mirror the reference names; ``*_all_live`` takes a flax module
 allocated), ``*_all_cold`` takes explicit counts.
 """
 
-from typing import Any, Optional
-
 import numpy as np
 
 
 def _fmt(nbytes: float) -> str:
     for unit in ("B", "KB", "MB", "GB", "TB"):
         if abs(nbytes) < 1024 or unit == "TB":
-            return f"{nbytes / 1 :.2f}{unit}" if unit == "B" \
-                else f"{nbytes:.2f}{unit}"
+            return f"{nbytes:.2f}{unit}"
         nbytes /= 1024.0
-    return f"{nbytes:.2f}TB"
 
 
 def _model_counts(model, example_batch=None, rng=None):

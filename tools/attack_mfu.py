@@ -24,7 +24,6 @@ Usage: python tools/attack_mfu.py [--tag r04] [--budget_s 1800]
 """
 
 import argparse
-import itertools
 import json
 import os
 import subprocess
@@ -33,6 +32,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from chip_sweep import probe as _sweep_probe  # noqa: E402 (shared probe)
 
 BASELINE_TFLOPS = 157.0
 
@@ -69,14 +71,7 @@ def spec_of(cfg):
 
 
 def probe(deadline=60):
-    src = ("import json, time\nimport jax\nd=jax.devices()\n"
-           "print(json.dumps({'n': len(d)}))\n")
-    try:
-        r = subprocess.run([sys.executable, "-c", src], capture_output=True,
-                           text=True, timeout=deadline)
-        return r.returncode == 0 and "{" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    return _sweep_probe(sys.executable, deadline) is not None
 
 
 def measure(cfg, state, cap_s):
@@ -84,13 +79,14 @@ def measure(cfg, state, cap_s):
     k = key_of(cfg)
     if k in state["results"]:
         return state["results"][k]
-    cmd = ["env", "JAX_COMPILATION_CACHE_DIR=/tmp/deepspeed_tpu_jax_bench_cache",
-           sys.executable, os.path.join(REPO, "bench.py"), "--candidate",
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--candidate",
            json.dumps(spec_of(cfg))]
+    env = {**os.environ, "JAX_COMPILATION_CACHE_DIR":
+           "/tmp/deepspeed_tpu_jax_bench_cache"}
     t0 = time.time()
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=cap_s, cwd=REPO)
+                           timeout=cap_s, cwd=REPO, env=env)
         lines = [ln for ln in r.stdout.splitlines()
                  if ln.strip().startswith("{")]
         rec = json.loads(lines[-1]) if lines else {
