@@ -51,7 +51,7 @@ def main():
     results = []
 
     def run_cfg(tag, remat, attention_impl, B, T, remat_policy="nothing",
-                vocab=32000, fbq=512, fbk=512):
+                vocab=32000, fbq=512, fbk=512, lchunk=0):
         if args.tiny:
             B, T, vocab = 2, 64, 256
             cfg = LlamaConfig(vocab_size=vocab, hidden_size=64,
@@ -60,7 +60,8 @@ def main():
                               max_position_embeddings=max(T, 128),
                               remat=remat, attention_impl=attention_impl,
                               remat_policy=remat_policy,
-                              flash_block_q=fbq, flash_block_k=fbk)
+                              flash_block_q=fbq, flash_block_k=fbk,
+                              loss_chunk=min(lchunk, 32) if lchunk else 0)
         else:
             cfg = LlamaConfig(vocab_size=vocab, hidden_size=1024,
                               intermediate_size=2816,
@@ -69,7 +70,8 @@ def main():
                               max_position_embeddings=max(T, 1024),
                               remat=remat, attention_impl=attention_impl,
                               remat_policy=remat_policy,
-                              flash_block_q=fbq, flash_block_k=fbk)
+                              flash_block_q=fbq, flash_block_k=fbk,
+                              loss_chunk=lchunk)
         model = LlamaForCausalLM(cfg)
         ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)))
         params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)["params"]
@@ -114,6 +116,8 @@ def main():
         print(json.dumps(rec), flush=True)
 
     run_cfg("baseline(remat,flash)", True, "flash", 8, 1024)
+    run_cfg("dots,flash,lc2048", True, "flash", 8, 1024,
+            remat_policy="dots", lchunk=2048)  # chunked-xent delta
     run_cfg("no-remat,flash", False, "flash", 8, 1024)
     if not args.quick:
         run_cfg("remat-dots,flash", True, "flash", 8, 1024, remat_policy="dots")
