@@ -276,7 +276,25 @@ def main():
     # 2) candidates, best-first, each in a capped subprocess. The ladder
     # covers every lever built since r1 (r3 verdict weak #1): remat policy
     # (incl. host-offload residuals), batch, flash tile sizes, Pallas Adam.
-    if tiny:
+    # A committed BENCH_LADDER.json (written by tools/attack_mfu.py from
+    # MEASURED results) overrides the static order, so the driver's
+    # round-end run tries the proven-best configs first.
+    override = None
+    if not tiny:
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "BENCH_LADDER.json")) as f:
+                override = json.load(f)
+            assert isinstance(override, list) and all(
+                "tag" in c and "policy" in c and "batch" in c
+                for c in override)
+            log(f"bench: using measured ladder ({len(override)} candidates "
+                f"from BENCH_LADDER.json)")
+        except (OSError, ValueError, AssertionError):
+            override = None
+    if override:
+        candidates = override
+    elif tiny:
         # CPU smoke: prove the harness + the lever plumbing at shapes the
         # interpret-mode kernels can run in seconds. offload policies need
         # TPU memory-space placement, so they are chip-only candidates.
