@@ -193,6 +193,32 @@ def main():
 
     run("decode_attention_int8", decode_int8)
 
+    # ---- weight-int8 matmul (vector_matmul_int8 / dequantize.cu) -----
+    from deepspeed_tpu.ops.pallas.int8_matmul import (int8_matmul,
+                                                      quantize_weight_per_col)
+
+    def int8_mm():
+        mk, mn = (1024, 4096) if on_tpu else (128, 256)
+        xb = jnp.asarray(rs.randn(8, mk), jnp.float32)
+        wf = jnp.asarray(rs.randn(mk, mn) * 0.1, jnp.float32)
+        wq, sc = quantize_weight_per_col(wf)
+        pal = jax.jit(lambda x, w, s: int8_matmul(
+            x, w, s, interpret=not on_tpu))
+        # highest-precision reference: TPU default matmul precision is
+        # bf16-pass (error O(mag * 2^-9) >> tol at K=1024); the kernel
+        # accumulates in fp32, so the reference must too
+        xla = jax.jit(lambda x, w, s: jax.lax.dot(
+            x, (w.astype(jnp.float32) * s[None, :]).astype(x.dtype),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32).astype(x.dtype))
+        got = pal(xb, wq, sc)
+        ref = xla(xb, wq, sc)
+        return _record("int8_matmul", mode, ref, got,
+                       _timeit(pal, xb, wq, sc), _timeit(xla, xb, wq, sc),
+                       2e-3)
+
+    run("int8_matmul", int8_mm)
+
     # ---- fused Adam / LAMB -------------------------------------------
     import optax
 

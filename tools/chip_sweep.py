@@ -10,8 +10,9 @@ backend. This version is built for short windows:
   1. steps run money-first: bench (headline TFLOPs) before everything else;
   2. a 60 s re-probe runs BEFORE every step — the moment the backend stops
      answering the sweep exits (rc 2) instead of burning caps;
-  3. the kernels step runs per-kernel (6 capped subprocesses, merged into
-     one KERNELS_<tag>.json) so one hung Mosaic compile can't eat a window;
+  3. the kernels step runs per-kernel (one capped subprocess per entry in
+     KERNEL_NAMES, merged into one KERNELS_<tag>.json) so one hung Mosaic
+     compile can't eat a window;
   4. state persists in CHIP_SWEEP_STATE_<tag>.json: on the next window,
      --resume skips every step already captured ok.
 
@@ -31,8 +32,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 KERNEL_NAMES = ["flash_fwd", "flash_bwd_dq", "block_sparse_fwd",
-                "decode_attention", "decode_attention_int8", "fused_adam",
-                "fused_lamb"]
+                "decode_attention", "decode_attention_int8", "int8_matmul",
+                "fused_adam", "fused_lamb"]
 
 PROBE = ("import json, time\nt0=time.time()\nimport jax\n"
          "d=jax.devices()\nprint(json.dumps({'n': len(d), "
