@@ -257,17 +257,24 @@ def main():
             # measured on hardware), the source field says when/how.
             cached = _best_window_capture()
             if cached is not None:
+                # value stays null on outage so the headline always reflects
+                # a measurement of THIS run's code; the prior chip-window
+                # capture rides along under detail.cached_* with provenance
+                # (advisor r4: consumers that read only `value` must never
+                # attribute a stale measurement to the current commit).
                 rn = cached["_round"]
-                emit(cached["value"], cached.get("vs_baseline"),
-                     detail=dict(cached.get("detail") or {},
-                                 source=f"resumable chip-window capture from "
-                                        f"round {rn} "
-                                        f"({cached['_artifact']}; backend "
-                                        f"down at this run — see "
-                                        f"tools/chip_sweep.py)",
-                                 artifact=cached["_artifact"]),
-                     error=f"backend unavailable NOW: {why}; value is a "
-                           f"hardware measurement from {cached['_artifact']}")
+                emit(None, None,
+                     detail={"cached_value": cached["value"],
+                             "cached_vs_baseline": cached.get("vs_baseline"),
+                             "cached_detail": cached.get("detail") or {},
+                             "source": f"resumable chip-window capture from "
+                                       f"round {rn} ({cached['_artifact']}); "
+                                       f"backend down at this run — see "
+                                       f"tools/chip_sweep.py",
+                             "artifact": cached["_artifact"]},
+                     error=f"backend unavailable NOW: {why}; "
+                           f"detail.cached_value is a hardware measurement "
+                           f"from {cached['_artifact']}")
                 return
             emit(None, None, error=f"backend unavailable: {why}")
             return

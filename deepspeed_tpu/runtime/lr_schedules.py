@@ -136,6 +136,18 @@ def get_lr_schedule(name: Optional[str], params: Dict[str, Any],
     return SCHEDULE_REGISTRY[name](**params)
 
 
+def _str2bool(v):
+    """argparse ``type=bool`` treats any non-empty string ('False', '0') as
+    True; parse boolean flag values explicitly instead."""
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("true", "t", "yes", "y", "1"):
+        return True
+    if v.lower() in ("false", "f", "no", "n", "0"):
+        return False
+    raise ValueError(f"expected a boolean, got {v!r}")
+
+
 def add_tuning_arguments(parser):
     """Reference ``lr_schedules.py:55``: attach the convergence-tuning CLI
     group (schedule selection + per-schedule knobs) to an argparse parser.
@@ -149,7 +161,8 @@ def add_tuning_arguments(parser):
     group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
     group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
     group.add_argument("--lr_range_test_step_size", type=int, default=1000)
-    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--lr_range_test_staircase", type=_str2bool,
+                       default=False)
     group.add_argument("--cycle_first_step_size", type=int, default=1000)
     group.add_argument("--cycle_first_stair_count", type=int, default=-1)
     group.add_argument("--cycle_second_step_size", type=int, default=-1)
@@ -158,7 +171,7 @@ def add_tuning_arguments(parser):
     group.add_argument("--cycle_min_lr", type=float, default=0.01)
     group.add_argument("--cycle_max_lr", type=float, default=0.1)
     group.add_argument("--decay_lr_rate", type=float, default=0.0)
-    group.add_argument("--cycle_momentum", type=bool, default=True)
+    group.add_argument("--cycle_momentum", type=_str2bool, default=True)
     group.add_argument("--cycle_min_mom", type=float, default=0.8)
     group.add_argument("--cycle_max_mom", type=float, default=0.9)
     group.add_argument("--decay_mom_rate", type=float, default=0.0)

@@ -37,10 +37,26 @@ def _fmt(nbytes: float) -> str:
         nbytes /= 1024.0
 
 
+# path components that mark a scanned layer stack (nn.scan over blocks):
+# leaves below them carry the layer count as their leading dim
+_STACK_KEYS = ("layers", "blocks", "block", "h")
+# unscanned per-layer submodules: layers_0 / h_7 / block_3 — one group each
+_LAYER_RE = __import__("re").compile(r"^(layers?|blocks?|h)_\d+$")
+
+
 def _model_counts(model, example_batch=None, rng=None):
     """(total_params, largest_layer_params) via eval_shape — allocates
     nothing (the reference iterates live torch params; flax modules are
-    functional, so shapes come from abstract init)."""
+    functional, so shapes come from abstract init).
+
+    largest_layer_params groups leaves per module rather than taking the
+    single biggest leaf (which understated a block by ~6x): the reference
+    maxes direct params per module (``stage3.py:2449-2459``,
+    ``recurse=False``); here a scanned block subtree is grouped as ONE
+    per-layer module (sum of its leaves / stack depth) because that is the
+    exact granularity ``runtime/zero/infinity.py`` streams into HBM, and
+    unscanned leaves group by their parent module (kernel+bias together).
+    """
     import jax
 
     if example_batch is None:
@@ -49,9 +65,31 @@ def _model_counts(model, example_batch=None, rng=None):
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     kwargs = dict(example_batch)
     shapes = jax.eval_shape(lambda: model.init(rng, **kwargs))
-    leaves = jax.tree_util.tree_leaves(shapes)
-    sizes = [int(np.prod(x.shape)) for x in leaves]
-    return sum(sizes), max(sizes) if sizes else 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(shapes)
+    total = 0
+    groups = {}
+    for path, leaf in flat:
+        keys = [str(getattr(p, "key", p)) for p in path]
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += size
+        stack_idx = next(
+            (i for i, k in enumerate(keys) if k in _STACK_KEYS), None)
+        layer_idx = next(
+            (i for i, k in enumerate(keys) if _LAYER_RE.match(k)), None)
+        if stack_idx is not None and getattr(leaf, "ndim", 0) >= 1:
+            # scanned stack: leading dim is the layer count; accumulate one
+            # layer's share into a single per-block group
+            key = tuple(keys[:stack_idx + 1])
+            groups[key] = groups.get(key, 0) + size // max(leaf.shape[0], 1)
+        elif layer_idx is not None:
+            # unscanned per-layer submodule (layers_3/...): the whole block
+            # subtree is one group, same granularity as the scanned case
+            key = tuple(keys[:layer_idx + 1])
+            groups[key] = groups.get(key, 0) + size
+        else:
+            key = tuple(keys[:-1])
+            groups[key] = groups.get(key, 0) + size
+    return total, max(groups.values()) if groups else 0
 
 
 def estimate_zero3_model_states_mem_needs(
@@ -59,9 +97,12 @@ def estimate_zero3_model_states_mem_needs(
         num_gpus_per_node: int = 1, num_nodes: int = 1,
         cpu_offload: bool = True, cpu_offload_params: bool = True,
         zero_init: bool = True, additional_buffer_factor: float = 1.5):
-    """Per-(chip, host) bytes for one ZeRO-3 configuration (no printing).
-    Byte model: 2 (bf16 param) + 2 (bf16 grad) + 4 (fp32 master) + 8
-    (Adam moments) + 2 (master-update staging) = 18 B/param of model
+    """Per-(host, chip) bytes for one ZeRO-3 configuration (no printing).
+    Returns ``(host, hbm, largest_layer_memory)`` — host/cpu first, chip
+    second, matching the reference's ``(cpu_mem, gpu_mem, largest)`` tuple
+    order (``stage3.py:2408``) so code ported from it reads the right
+    columns. Byte model: 2 (bf16 param) + 2 (bf16 grad) + 4 (fp32 master)
+    + 8 (Adam moments) + 2 (master-update staging) = 18 B/param of model
     states, matching the reference's totals."""
     total_chips = num_nodes * num_gpus_per_node
     node_factor = 1 / num_nodes
@@ -94,7 +135,7 @@ def estimate_zero3_model_states_mem_needs(
         else:
             host = total_params * 4 * num_gpus_per_node \
                 * additional_buffer_factor
-    return int(hbm), int(host), largest_layer_memory
+    return int(host), int(hbm), largest_layer_memory
 
 
 def _print_table3(total_params, largest_layer_params, num_gpus_per_node,
@@ -110,7 +151,7 @@ def _print_table3(total_params, largest_layer_params, num_gpus_per_node,
     for co, cop, zi in ((True, True, True), (True, True, False),
                         (True, False, True), (True, False, False),
                         (False, False, True), (False, False, False)):
-        hbm, host, _ = estimate_zero3_model_states_mem_needs(
+        host, hbm, _ = estimate_zero3_model_states_mem_needs(
             total_params, largest_layer_params, num_gpus_per_node,
             num_nodes, cpu_offload=co, cpu_offload_params=cop, zero_init=zi,
             additional_buffer_factor=additional_buffer_factor)
@@ -143,7 +184,8 @@ def estimate_zero2_model_states_mem_needs(
         total_params: int, num_gpus_per_node: int = 1, num_nodes: int = 1,
         cpu_offload: bool = True, additional_buffer_factor: float = 1.5):
     """Stage 1/2: optimizer states sharded; bf16 params + grads replicated
-    per chip (4 B/param HBM)."""
+    per chip (4 B/param HBM). Returns ``(host, hbm)`` — host/cpu first,
+    matching the reference's ``(cpu_mem, gpu_mem)`` order."""
     total_chips = num_nodes * num_gpus_per_node
     node_factor = 1 / num_nodes
     if cpu_offload:
@@ -154,7 +196,7 @@ def estimate_zero2_model_states_mem_needs(
         hbm = 4 * total_params + 14 * total_params // total_chips
         host = total_params * 4 * num_gpus_per_node \
             * additional_buffer_factor
-    return int(hbm), int(host)
+    return int(host), int(hbm)
 
 
 def _print_table2(total_params, num_gpus_per_node, num_nodes,
@@ -167,7 +209,7 @@ def _print_table2(total_params, num_gpus_per_node, num_nodes,
           f"{int(total_params / 1e6)}M total params.")
     print("  per host  |  per chip |   Options")
     for co in (True, False):
-        hbm, host = estimate_zero2_model_states_mem_needs(
+        host, hbm = estimate_zero2_model_states_mem_needs(
             total_params, num_gpus_per_node, num_nodes, cpu_offload=co,
             additional_buffer_factor=additional_buffer_factor)
         print(f"  {_fmt(host):>9} | {_fmt(hbm):>9} | "

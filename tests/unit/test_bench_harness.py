@@ -46,9 +46,10 @@ def test_bench_decode_tiny_emits_json():
 def test_bench_unreachable_backend_still_emits_json():
     # a 1-second probe deadline cannot succeed against the tunneled backend;
     # the parent must still exit 0 with a JSON record carrying an explicit
-    # error. If a resumable chip-window capture exists for this round
-    # (BENCH_r*_local/_v2.json), its value is surfaced with provenance;
-    # otherwise value is null.
+    # error. The headline value is ALWAYS null on outage (it must reflect a
+    # measurement of this run's code); any resumable chip-window capture
+    # (BENCH_r*_local/_v2.json) rides along as detail.cached_value with
+    # provenance.
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env={**os.environ, "DS_BENCH_PROBE_S": "1"},
@@ -56,12 +57,11 @@ def test_bench_unreachable_backend_still_emits_json():
     assert r.returncode == 0, r.stderr[-2000:]
     rec = _last_json(r.stdout)
     assert "backend unavailable" in rec["error"]
+    assert rec["value"] is None
     sys.path.insert(0, REPO)
     import bench
     cached = bench._best_window_capture()
-    if cached is None:
-        assert rec["value"] is None
-    else:
-        assert rec["value"] == cached["value"]
+    if cached is not None:
+        assert rec["detail"]["cached_value"] == cached["value"]
         assert "chip-window capture" in rec["detail"]["source"]
         assert rec["detail"]["artifact"] == cached["_artifact"]

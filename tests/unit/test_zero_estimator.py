@@ -11,25 +11,32 @@ from deepspeed_tpu.runtime.zero import (
 
 
 def test_zero3_scaling_with_world_size():
+    # tuple order is (host, hbm, largest) — host/cpu first, matching the
+    # reference's (cpu_mem, gpu_mem, largest) contract (stage3.py:2408)
     n, ll = 1_000_000_000, 50_000_000
-    hbm1, host1, _ = estimate_zero3_model_states_mem_needs(
+    host1, hbm1, _ = estimate_zero3_model_states_mem_needs(
         n, ll, num_gpus_per_node=8, num_nodes=1,
         cpu_offload=False, cpu_offload_params=False)
-    hbm2, host2, _ = estimate_zero3_model_states_mem_needs(
+    host2, hbm2, _ = estimate_zero3_model_states_mem_needs(
         n, ll, num_gpus_per_node=8, num_nodes=2,
         cpu_offload=False, cpu_offload_params=False)
     assert hbm2 < hbm1            # model states shard over more chips
     # infinity mode: HBM independent of model size (largest block only)
-    hbm_inf, host_inf, _ = estimate_zero3_model_states_mem_needs(
+    host_inf, hbm_inf, _ = estimate_zero3_model_states_mem_needs(
         n, ll, cpu_offload=True, cpu_offload_params=True)
     assert hbm_inf == 4 * ll
     assert host_inf > 18 * n      # buffered host residency
+    # no-offload on one chip: HBM carries all 18 B/param, host only buffers
+    host_no, hbm_no, _ = estimate_zero3_model_states_mem_needs(
+        n, ll, num_gpus_per_node=1, num_nodes=1,
+        cpu_offload=False, cpu_offload_params=False)
+    assert hbm_no > host_no       # order can't be silently transposed
 
 
 def test_zero2_offload_moves_optimizer_off_chip():
     n = 100_000_000
-    hbm_off, _ = estimate_zero2_model_states_mem_needs(n, cpu_offload=True)
-    hbm_on, _ = estimate_zero2_model_states_mem_needs(n, cpu_offload=False)
+    _, hbm_off = estimate_zero2_model_states_mem_needs(n, cpu_offload=True)
+    _, hbm_on = estimate_zero2_model_states_mem_needs(n, cpu_offload=False)
     assert hbm_off == 4 * n
     assert hbm_on > hbm_off
 
@@ -54,3 +61,32 @@ def test_all_live_derives_counts_without_allocating(capsys):
         model, num_gpus_per_node=8, example_batch={"input_ids": ids})
     out = capsys.readouterr().out
     assert "total params" in out and "largest layer" in out
+
+
+def test_largest_layer_groups_scanned_block_per_layer():
+    # a scanned block's per-layer sum (qkv+o+mlp+norms), not the single
+    # biggest stacked leaf: the streamed-block granularity Infinity sizes
+    # HBM by (advisor r4 finding on _model_counts)
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.runtime.zero.estimator import _model_counts
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    total, largest = _model_counts(model, {"input_ids": ids})
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    kv = cfg.num_key_value_heads * (h // cfg.num_attention_heads)
+    per_block = (3 * h * inter          # gate/up/down
+                 + 2 * h * h            # q, o
+                 + 2 * h * kv           # k, v
+                 + 2 * h)               # two layernorm scales
+    assert largest == per_block
+    assert total > cfg.num_hidden_layers * per_block  # + embed/head/norm
+    # unscanned layout (layers_i subtrees) must size the block identically
+    import dataclasses
+    cfg2 = dataclasses.replace(cfg, scan_layers=False)
+    total2, largest2 = _model_counts(LlamaForCausalLM(cfg2),
+                                     {"input_ids": ids})
+    assert (total2, largest2) == (total, largest)
