@@ -1,1 +1,2 @@
 from .autotuner import Autotuner, autotune  # noqa: F401
+from .mfu_tuner import LEVER_AXES, MFUTuner  # noqa: F401

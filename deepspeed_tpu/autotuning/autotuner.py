@@ -48,6 +48,22 @@ def _merged(base: Dict, overrides: Dict) -> Dict:
     return out
 
 
+def timed_step_seconds(engine, batch, steps: int, warmup: int = 0) -> float:
+    """Mean seconds per ``train_batch`` after compile + warmup. The
+    ``float(loss)`` value fetches are the only reliable device fence on the
+    tunneled TPU platform (``block_until_ready`` returns early there)."""
+    loss = engine.train_batch(batch=batch)  # compile
+    float(loss)
+    for _ in range(warmup):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    return (time.perf_counter() - t0) / steps
+
+
 class Autotuner:
     """See module docstring. ``make_batch(global_batch_size) -> batch dict``
     supplies data at whatever batch size a candidate needs."""
@@ -128,13 +144,7 @@ class Autotuner:
                                    example_batch=self.example_batch,
                                    mesh=self.mesh)
         batch = self.make_batch(engine.train_batch_size)
-        loss = engine.train_batch(batch=batch)  # compile + warmup
-        float(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.train_batch(batch=batch)
-        float(loss)
-        dt = (time.perf_counter() - t0) / steps
+        dt = timed_step_seconds(engine, batch, steps)
         if self.cfg.metric == "latency":
             return -dt
         # default "throughput" (samples/sec); "flops" scales by model size
@@ -199,6 +209,28 @@ class Autotuner:
                 [exps[i].metric_value for i in evaluated_ok])
             pred = model.predict([feats[i] for i in remaining])
             pending.append(remaining[int(np.argmax(pred))])
+
+    def tune_mfu(self, axes: Optional[Dict] = None,
+                 budget_evals: int = 64, steps: int = 3) -> Dict:
+        """Drive the full MFU lever space (remat policy x flash tiles x
+        loss_chunk x micro/gas split x Pallas-Adam x attention impl) with
+        the memoized, cost-model-guided coordinate descent of
+        ``mfu_tuner.MFUTuner`` — the search ``tools/attack_mfu.py`` runs
+        against the live chip, exposed as a library API (reference
+        ``tuner/model_based_tuner.py``). Requires the model to be one of
+        this framework's config-dataclass families (``model.config``)."""
+        from .mfu_tuner import MFUTuner
+
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None or not dataclasses.is_dataclass(mcfg):
+            raise ValueError(
+                "tune_mfu needs a model with a dataclass .config carrying "
+                "the lever fields (remat_policy, flash_block_q/k, "
+                "loss_chunk, attention_impl)")
+        tuner = MFUTuner(type(self.model), mcfg, self.base_config,
+                         self.make_batch, axes=axes, mesh=self.mesh,
+                         steps=steps, results_dir=self.cfg.results_dir)
+        return tuner.tune(budget_evals=budget_evals)
 
     def tune(self, steps: Optional[int] = None) -> Dict:
         """Run the space; returns the best full config. Writes per-experiment

@@ -43,6 +43,13 @@ class DeepSpeedInferenceConfig:
     """
 
     mp_size: int = 1
+    #: expert parallelism for MoE serving (reference
+    #: ``inference/engine.py:194`` ``_create_ep_parallel_group``): stacked
+    #: expert weights ``[E, ...]`` shard their leading dim over the
+    #: ``expert`` mesh axis, so each group of devices holds E/ep_size
+    #: experts instead of replicating all of them per rank; the token
+    #: dispatch/combine collectives ride ICI, inserted by the partitioner.
+    ep_size: int = 1
     dtype: Any = None
     replace_with_kernel_inject: bool = True
     injection_policy: Optional[Any] = None

@@ -56,15 +56,19 @@ class InferenceEngine:
         self.module = module
         self.config = config
 
+        ep_size = getattr(config, "ep_size", 1)
         if mesh is None:
             mesh = get_mesh()
-        if mesh is None or (config.mp_size > 1 and
-                            dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
-                            != config.mp_size):
-            mesh = build_mesh(model=config.mp_size)
+        if mesh is not None:
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if mesh is None or \
+                (config.mp_size > 1 and axes.get("model", 1) != config.mp_size) or \
+                (ep_size > 1 and axes.get("expert", 1) != ep_size):
+            mesh = build_mesh(model=config.mp_size, expert=ep_size)
             set_mesh(mesh)
         self.mesh = mesh
         self.mp_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
+        self.ep_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("expert", 1)
 
         # ---- shard + cast params (reference: _convert_to_dtype :464 and
         # ReplaceWithTensorSlicing per-rank slicing) -----------------------
@@ -81,6 +85,11 @@ class InferenceEngine:
         if config.quantize:
             from ..compression.quantization import quantize_params
 
+            if ep_size > 1:
+                raise ValueError(
+                    "quantize with ep_size>1 is unsupported: quantized "
+                    "leaves are grouped-flat, so the stacked-expert leading "
+                    "dim the expert axis shards no longer exists")
             params, self._dequant_meta = quantize_params(params, config.quantize_groups)
             rules = None  # quantized leaves are grouped-flat; TP slicing n/a
         else:
@@ -97,7 +106,8 @@ class InferenceEngine:
         self._batch_world = int(np.prod([shape.get(a, 1) for a in BATCH_AXES]))
         self._forward_jit = None
         self._generate_cache: Dict[Any, Any] = {}
-        log_dist(f"InferenceEngine: mp={self.mp_world_size}, dtype={dtype}, "
+        log_dist(f"InferenceEngine: mp={self.mp_world_size}, "
+                 f"ep={self.ep_world_size}, dtype={dtype}, "
                  f"quantize={config.quantize}", ranks=[0])
 
     # ------------------------------------------------------------------
@@ -268,7 +278,8 @@ class InferenceEngine:
         return times
 
 
-def init_inference(model=None, config=None, mp_size: Optional[int] = None, dtype=None,
+def init_inference(model=None, config=None, mp_size: Optional[int] = None,
+                   ep_size: Optional[int] = None, dtype=None,
                    injection_policy=None, replace_with_kernel_inject: Optional[bool] = None,
                    checkpoint: Optional[str] = None, params=None, mesh=None,
                    quantize: Optional[bool] = None, **kwargs) -> InferenceEngine:
@@ -282,7 +293,7 @@ def init_inference(model=None, config=None, mp_size: Optional[int] = None, dtype
         merged = dict(config)
     else:
         merged = {}
-    for k, v in [("mp_size", mp_size), ("dtype", dtype),
+    for k, v in [("mp_size", mp_size), ("ep_size", ep_size), ("dtype", dtype),
                  ("injection_policy", injection_policy),
                  ("replace_with_kernel_inject", replace_with_kernel_inject),
                  ("checkpoint", checkpoint), ("quantize", quantize)]:

@@ -663,3 +663,24 @@ def test_int8_kv_cache_composes_with_tensor_parallel():
                            mesh=build_mesh(data=2, model=4))
     out2 = np.asarray(e2.generate(ids, max_new_tokens=5))
     np.testing.assert_array_equal(out1, out2)
+
+
+def test_quantize_on_ambient_expert_mesh_still_allowed():
+    """A leftover training mesh with an expert axis must not block int8
+    serving when the user did not request EP (ep_size defaults to 1:
+    quantized leaves are replicated, the expert axis is simply unused)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.parallel.topology import set_mesh
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    set_mesh(build_mesh(data=2, expert=4), None)
+    engine = ds.init_inference(model, params=params, dtype="int8")
+    assert engine.ep_world_size == 4  # ambient mesh reused, not rejected
+    out = np.asarray(engine.generate(ids, max_new_tokens=3))
+    assert out.shape == (2, 3)
