@@ -684,3 +684,94 @@ def test_quantize_on_ambient_expert_mesh_still_allowed():
     assert engine.ep_world_size == 4  # ambient mesh reused, not rejected
     out = np.asarray(engine.generate(ids, max_new_tokens=3))
     assert out.shape == (2, 3)
+
+
+# ---------------------------------------------------------------------------
+# Pretrained-checkpoint-shaped smoke tests (reference
+# tests/unit/inference/test_inference.py:15 sweeps real HF checkpoints; no
+# pretrained weights ship in this image and egress is zero, so these cover
+# the same edge surface offline: real tokenizer round trip, tied head,
+# safetensors (sharded) serialization, GQA at non-toy ratio)
+# ---------------------------------------------------------------------------
+
+
+def _byte_level_gpt2_tokenizer_files(dirpath):
+    """Synthesize a valid byte-level GPT2 tokenizer (256-symbol vocab, no
+    merges): encodes arbitrary text, so the text->ids->generate->decode
+    round trip is real without a downloaded vocab."""
+    import json
+    import os
+
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    vocab = {sym: i for i, sym in enumerate(bytes_to_unicode().values())}
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(os.path.join(dirpath, "vocab.json"), "w") as f:
+        json.dump(vocab, f)
+    with open(os.path.join(dirpath, "merges.txt"), "w") as f:
+        f.write("#version: 0.2\n")
+    return len(vocab)
+
+
+def test_checkpoint_dir_tokenizer_roundtrip_greedy_text_equality(tmp_path):
+    """End-to-end 'pretrained' pipeline: tokenizer.encode -> init_inference
+    (safetensors checkpoint dir, tied wte/lm_head) -> greedy generate ->
+    tokenizer.decode, text-equal to transformers running the same loop."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import deepspeed_tpu as ds
+
+    vocab_size = _byte_level_gpt2_tokenizer_files(str(tmp_path))
+    torch.manual_seed(7)
+    cfg = transformers.GPT2Config(
+        vocab_size=vocab_size, n_positions=64, n_embd=32, n_layer=2,
+        n_head=2, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    # GPT2 ties wte and lm_head by default — assert the premise
+    assert hf.transformer.wte.weight.data_ptr() == \
+        hf.lm_head.weight.data_ptr()
+    hf.save_pretrained(tmp_path)  # safetensors by default
+    assert (tmp_path / "model.safetensors").exists()
+
+    tok = transformers.GPT2Tokenizer.from_pretrained(str(tmp_path))
+    prompt = "hello tpu framework"
+    ids = tok(prompt, return_tensors="np")["input_ids"]
+
+    engine = ds.init_inference(checkpoint=str(tmp_path), dtype="fp32")
+    ours = np.asarray(engine.generate(ids, max_new_tokens=8,
+                                      do_sample=False))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                          do_sample=False,
+                          pad_token_id=tok.eos_token_id).numpy()[:, ids.shape[1]:]
+    np.testing.assert_array_equal(ours, ref)
+    assert tok.decode(ours[0]) == tok.decode(ref[0])
+
+
+def test_checkpoint_dir_gqa_tied_sharded_safetensors(tmp_path):
+    """Llama-style GQA at a non-toy ratio (8 q heads : 2 kv heads, 4 layers)
+    with tied embeddings through a SHARDED safetensors checkpoint dir."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import os
+
+    import deepspeed_tpu as ds
+
+    torch.manual_seed(11)
+    cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=True)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    hf.save_pretrained(tmp_path, max_shard_size="500KB")
+    assert any("index.json" in f for f in os.listdir(tmp_path)), \
+        "expected a sharded safetensors checkpoint"
+
+    engine = ds.init_inference(checkpoint=str(tmp_path), dtype="fp32")
+    ids = np.random.RandomState(5).randint(1, 512, (2, 12))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                          do_sample=False, pad_token_id=0).numpy()[:, 12:]
+    ours = np.asarray(engine.generate(ids, max_new_tokens=6,
+                                      do_sample=False))
+    np.testing.assert_array_equal(ours, ref)
