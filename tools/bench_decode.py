@@ -36,33 +36,54 @@ def log(msg):
 
 
 def run_point(batch: int, prompt: int, new: int, tiny: bool,
-              impl: str = "xla") -> dict:
+              impl: str = "xla", model_family: str = "llama",
+              ep: int = 1) -> dict:
     import jax
 
     if tiny:
         # smoke mode must not wait on a real accelerator (env vars cannot
         # switch platforms here; the config route always works)
         jax.config.update("jax_platforms", "cpu")
+        if ep > 1:
+            jax.config.update("jax_num_cpu_devices", max(ep, 1))
 
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
     attn_impl = "pallas" if impl == "pallas_int8" else impl
     kv_int8 = impl == "pallas_int8"
-    if tiny:
-        cfg = LlamaConfig.tiny(remat=False, decode_attention_impl=attn_impl)
+    if model_family == "mixtral":
+        # MoE serving point (reference: Mixtral-8x7B is a BASELINE config;
+        # ep>1 shards the stacked expert leaves via init_inference ep_size)
+        from deepspeed_tpu.models import MixtralConfig, MixtralForCausalLM
+
+        if tiny:
+            cfg = MixtralConfig.tiny(decode_attention_impl=attn_impl)
+        else:
+            cfg = MixtralConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=8, num_local_experts=8,
+                num_experts_per_tok=2, max_position_embeddings=prompt + new,
+                remat=False, decode_attention_impl=attn_impl)
+        model = MixtralForCausalLM(cfg)
     else:
-        cfg = LlamaConfig.llama_400m(
-            max_position_embeddings=prompt + new, remat=False,
-            decode_attention_impl=attn_impl)
-    model = LlamaForCausalLM(cfg)
+        from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        if tiny:
+            cfg = LlamaConfig.tiny(remat=False,
+                                   decode_attention_impl=attn_impl)
+        else:
+            cfg = LlamaConfig.llama_400m(
+                max_position_embeddings=prompt + new, remat=False,
+                decode_attention_impl=attn_impl)
+        model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  jax.numpy.asarray(ids[:1]))["params"]
     engine = ds.init_inference(model, params=params, dtype="bf16",
                                max_out_tokens=prompt + new,
-                               kv_cache_int8=kv_int8)
+                               kv_cache_int8=kv_int8, ep_size=ep)
 
     def best_of(fn, n=3):
         """min over repeats — single-shot timings at millisecond scale are
@@ -88,7 +109,7 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
                   if extra_steps > 0 and dt > ttft else None)
 
     return {
-        "impl": impl,
+        "impl": impl, "model": model_family, "ep": ep,
         # off-TPU the pallas impl silently falls back to the XLA reference;
         # record the backend so committed numbers can't mislabel what ran
         "backend": jax.default_backend(),
@@ -138,11 +159,17 @@ def main():
                     help="decode attention: XLA repeat_kv path, the Pallas "
                          "softmax_context-equivalent kernel, or the kernel "
                          "over an int8 KV cache (half the cache bandwidth)")
+    ap.add_argument("--model", default="llama", choices=("llama", "mixtral"),
+                    help="flagship dense decode or the MoE serving graph")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree for --model mixtral "
+                         "(init_inference ep_size)")
     args = ap.parse_args()
 
     if args.one:
         b, p, n = args.one
-        print(json.dumps(run_point(b, p, n, args.tiny, args.impl)), flush=True)
+        print(json.dumps(run_point(b, p, n, args.tiny, args.impl,
+                                   args.model, args.ep)), flush=True)
         return
 
     probe_deadline = float(os.environ.get("DS_BENCH_PROBE_S", "60"))
@@ -153,10 +180,16 @@ def main():
     # tiny decode runs long enough (64 new tokens) that the 2-run
     # difference is decode-dominated — 8 tokens sat inside timer jitter
     # and produced null throughput records
+    # latency point (bs=1), the reference-blog-like serving points, and
+    # realistic batch/prompt (r4 verdict: batch 8-64, prompt 512-2048)
     points = ([(1, 16, 64), (2, 16, 64)] if args.tiny
-              else [(1, 128, 128), (8, 512, 128), (32, 1024, 128)])
+              else [(1, 128, 128), (8, 512, 128), (32, 1024, 128),
+                    (64, 2048, 128)])
 
-    summary = {"metric": "llama400m_decode", "impl": args.impl, "points": []}
+    metric = ("mixtral_small_decode" if args.model == "mixtral"
+              else "llama400m_decode")
+    summary = {"metric": metric, "impl": args.impl, "model": args.model,
+               "ep": args.ep, "points": []}
     if not args.tiny:
         log(f"bench_decode: probing backend (deadline {probe_deadline:.0f}s)")
         probe = ("import json, time\nt0 = time.time()\nimport jax\n"
@@ -177,7 +210,8 @@ def main():
     for b, p, n in points:
         tag = f"b{b},p{p},n{n}"
         log(f"bench_decode: point {tag} (cap {point_cap:.0f}s)")
-        argv = ["--one", str(b), str(p), str(n), "--impl", args.impl] \
+        argv = ["--one", str(b), str(p), str(n), "--impl", args.impl,
+                "--model", args.model, "--ep", str(args.ep)] \
             + (["--tiny"] if args.tiny else [])
         rec, why = _run_sub(argv, point_cap)
         if rec is None:
@@ -209,5 +243,7 @@ if __name__ == "__main__":
         try:
             main()
         except Exception as e:  # guaranteed JSON on any parent failure
-            print(json.dumps({"metric": "llama400m_decode", "points": [],
+            metric = ("mixtral_small_decode"
+                      if "mixtral" in sys.argv else "llama400m_decode")
+            print(json.dumps({"metric": metric, "points": [],
                               "error": f"{type(e).__name__}: {e}"}), flush=True)

@@ -1,0 +1,176 @@
+"""MoE decode-MLP isolation: is XLA's fused dispatch kernel-class?
+
+The reference ships dedicated MoE inference kernels — ``moe_res_matmul``,
+``einsum_sec_sm_ecm`` (``csrc/transformer/inference/csrc/pt_binding.cpp:
+1327-1333``) — because at decode shapes the gate->dispatch->expert-GEMM->
+combine chain is bandwidth-bound and a naive framework implementation adds
+dispatch overhead on top. Our thesis is that the stacked-expert einsum
+formulation (``models/mixtral.py``) lets XLA fuse that chain to the same
+class; this tool MEASURES the thesis instead of asserting it:
+
+  1. ``moe_ms``    — one Mixtral sparse-MoE block on a decode-shaped
+                     ``[B, 1, H]`` activation (top-k dispatch + E stacked
+                     SwiGLU experts + weighted combine), jitted alone.
+  2. ``dense_ms``  — a FLOPs-equivalent dense SwiGLU MLP (intermediate =
+                     k x I: same useful GEMM work per token, zero routing),
+                     the already-fused baseline XLA is known to handle.
+  3. ``overhead``  — moe_ms / dense_ms. The reference's kernels exist to
+                     push this toward the weight-streaming ratio; dispatch
+                     overhead beyond the extra weight traffic is what a
+                     custom kernel would reclaim.
+  4. HBM accounting — decode MLP time is weight-streaming-bound: dense
+                     streams 3*H*(k*I) weights; the MoE block streams the
+                     TOUCHED experts' 3*H*I each (<= min(B*k, E) of E).
+                     Achieved GB/s vs those bytes says how close each sits
+                     to bandwidth-bound (= kernel-class) execution.
+  5. fusion stats — kernel counts from the compiled HLO of each program
+                     (a fused chain is a handful of fusions, not dozens of
+                     standalone ops).
+
+Writes one JSON line; commit as ``MOE_DECODE_r{N}.json``. ``--tiny`` runs
+CPU-compiled toy shapes (harness proof; timings labeled by backend).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/deepspeed_tpu_jax_bench_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _kernel_count(compiled_text: str) -> dict:
+    """Rough kernel census of optimized HLO: fusions + standalone
+    (non-fused) instruction computations at module scope."""
+    fusions = compiled_text.count(" fusion(")
+    customs = compiled_text.count(" custom-call(")
+    return {"fusions": fusions, "custom_calls": customs}
+
+
+def bench(batch: int, hidden: int, intermediate: int, experts: int, k: int,
+          tiny: bool, iters: int = 50) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    if tiny:
+        jax.config.update("jax_platforms", "cpu")
+
+    import flax.linen as nn
+
+    from deepspeed_tpu.models.mixtral import (MixtralConfig,
+                                              MixtralSparseMoeBlock)
+
+    cfg = MixtralConfig(
+        vocab_size=256, hidden_size=hidden, intermediate_size=intermediate,
+        num_hidden_layers=1, num_attention_heads=max(hidden // 64, 1),
+        num_key_value_heads=max(hidden // 64, 1),
+        num_local_experts=experts, num_experts_per_tok=k, remat=False)
+    moe = MixtralSparseMoeBlock(cfg)
+
+    class DenseSwiGLU(nn.Module):
+        """FLOPs-equivalent dense MLP: intermediate = k x I, no routing.
+        bf16 params + compute to match the MoE block's compute dtype (and
+        the 2-byte weight-streaming byte model below)."""
+
+        @nn.compact
+        def __call__(self, x):
+            d = dict(use_bias=False, dtype=jnp.bfloat16,
+                     param_dtype=jnp.bfloat16)
+            gate = nn.Dense(k * intermediate, name="gate", **d)(x)
+            up = nn.Dense(k * intermediate, name="up", **d)(x)
+            return nn.Dense(hidden, name="down", **d)(nn.silu(gate) * up)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, 1, hidden),
+                    jnp.bfloat16)
+    # both sides stream bf16 weights from HBM: cast every MoE param
+    # (including the [H, E] router — byte-negligible) so the comparison and
+    # the 2-byte accounting are dtype-honest
+    moe_params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), moe.init(jax.random.PRNGKey(0), x))
+    dense = DenseSwiGLU()
+    dense_params = dense.init(jax.random.PRNGKey(1), x)
+
+    def moe_fn(p, x):
+        return moe.apply(p, x)[0]
+
+    def dense_fn(p, x):
+        return dense.apply(p, x)
+
+    timings = {}
+    hlo = {}
+    for name, fn, p in (("moe", moe_fn, moe_params),
+                        ("dense", dense_fn, dense_params)):
+        jf = jax.jit(fn)
+        lowered = jf.lower(p, x)
+        hlo[name] = _kernel_count(lowered.compile().as_text())
+        out = jf(p, x)
+        np.asarray(out)  # compile fence
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = jf(p, x)
+            np.asarray(out)  # value fetch = the only reliable fence
+            dt = (time.perf_counter() - t0) / iters
+            best = dt if best is None else min(best, dt)
+        timings[name] = best
+
+    # weight-streaming byte model (bf16): decode MLPs are weight-bound.
+    # The stacked-einsum formulation computes ALL E experts per token (the
+    # combine mask zeroes the untaken ones), so the ACTUAL traffic is all E
+    # experts' weights; a gather-based kernel (what the reference's MoE
+    # kernels amount to) would stream only the touched <= min(B*k, E).
+    touched = min(batch * k, experts)
+    moe_bytes_actual = experts * 3 * hidden * intermediate * 2
+    moe_bytes_gather_ideal = touched * 3 * hidden * intermediate * 2
+    dense_bytes = 3 * hidden * (k * intermediate) * 2
+    rec = {
+        "metric": "moe_decode_isolation",
+        "backend": jax.default_backend(),
+        "batch": batch, "hidden": hidden, "intermediate": intermediate,
+        "experts": experts, "top_k": k,
+        "moe_ms": round(timings["moe"] * 1e3, 3),
+        "dense_equiv_ms": round(timings["dense"] * 1e3, 3),
+        "moe_overhead_vs_dense": round(timings["moe"] / timings["dense"], 3),
+        # all-E streaming vs the dense baseline's k*I weights
+        "expected_weight_traffic_ratio":
+            round(moe_bytes_actual / dense_bytes, 3),
+        # what a token-gather kernel could still reclaim (1.0 = nothing)
+        "gather_kernel_opportunity":
+            round(moe_bytes_actual / moe_bytes_gather_ideal, 3),
+        "moe_achieved_gbps":
+            round(moe_bytes_actual / timings["moe"] / 1e9, 1),
+        "dense_achieved_gbps":
+            round(dense_bytes / timings["dense"] / 1e9, 1),
+        "hlo_kernels": hlo,
+    }
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    if args.tiny:
+        rec = bench(batch=2, hidden=64, intermediate=128, experts=4, k=2,
+                    tiny=True, iters=10)
+    else:
+        # Mixtral-8x7B block shape: the BASELINE.json MoE serving config
+        rec = bench(batch=args.batch, hidden=4096, intermediate=14336,
+                    experts=8, k=2, tiny=False)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"metric": "moe_decode_isolation",
+                          "error": f"{type(e).__name__}: {e}"}), flush=True)
+        sys.exit(1)

@@ -104,7 +104,8 @@ def run_capped(cmd, cap_s, out_path=None, log_name=None):
     return rec
 
 
-DECODE_POINTS = 3  # bench_decode's non-tiny sweep: (1,128), (8,512), (32,1024)
+# bench_decode's non-tiny sweep: (1,128), (8,512), (32,1024), (64,2048)
+DECODE_POINTS = 4
 
 
 def _merge_decode_lines(stdout, merged, rec):
@@ -128,26 +129,31 @@ def _merge_decode_lines(stdout, merged, rec):
                 rec[k] = str(obj[k])[:300]
 
 
-def run_decode_merged(py, tag, state, impl, cap=1500):
+def run_decode_merged(py, tag, state, impl, cap=1800, model="llama"):
     """Run bench_decode and merge its points into per-window state, so a
-    window that captures 1 of 3 points still counts, never clobbers a
+    window that captures 1 of 4 points still counts, never clobbers a
     fuller artifact, and the missing points retry next window.
 
-    cap covers bench_decode's own worst case (60s probe + 3 x 420s point
+    cap covers bench_decode's own worst case (60s probe + 4 x 420s point
     caps); the merge path reads streamed per-point lines out of a timed-out
     process's partial stdout, so even the outer kill keeps finished points."""
-    key = f"decode_points_{impl}"
+    key = f"decode_points_{impl}" if model == "llama" \
+        else f"decode_points_{model}_{impl}"
     merged = state.setdefault(key, {})
     cmd = [py, "tools/bench_decode.py"]
     if impl != "xla":
         cmd += ["--impl", impl]
+    if model != "llama":
+        cmd += ["--model", model]
     t0 = time.time()
     rec = {"elapsed_s": None}
+    log_name = f"decode_{impl}" if model == "llama" \
+        else f"decode_{model}_{impl}"
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=cap,
                            cwd=REPO)
         _merge_decode_lines(r.stdout, merged, rec)
-        _tee_log(f"decode_{impl}", cmd, r.stdout, r.stderr)
+        _tee_log(log_name, cmd, r.stdout, r.stderr)
         if r.returncode != 0 and "error" not in rec:
             rec["error"] = "rc={}: {}".format(
                 r.returncode,
@@ -155,13 +161,16 @@ def run_decode_merged(py, tag, state, impl, cap=1500):
     except subprocess.TimeoutExpired as e:
         rec["error"] = f"timeout after {cap}s"
         _merge_decode_lines(_text(e.stdout), merged, rec)
-        _tee_log(f"decode_{impl}", cmd, _text(e.stdout), _text(e.stderr))
+        _tee_log(log_name, cmd, _text(e.stdout), _text(e.stderr))
     rec["elapsed_s"] = round(time.time() - t0, 1)
     if merged:
-        out = f"DECODE_{tag}.json" if impl == "xla" \
-            else f"DECODE_{tag}_{impl}.json"
+        stem = f"DECODE_{tag}" if model == "llama" else f"DECODE_{tag}_{model}"
+        out = f"{stem}.json" if impl == "xla" else f"{stem}_{impl}.json"
+        metric = ("llama400m_decode" if model == "llama"
+                  else f"{model}_small_decode")
         with open(os.path.join(REPO, out), "w") as f:
-            f.write(json.dumps({"metric": "llama400m_decode", "impl": impl,
+            f.write(json.dumps({"metric": metric, "impl": impl,
+                                "model": model,
                                 "points": list(merged.values())}) + "\n")
         rec["artifact"] = out
     rec["ok"] = len(merged) >= DECODE_POINTS
@@ -268,11 +277,16 @@ def main():
         # it explains whatever number bench just produced (r4 window 1:
         # 3 s/step where r1 had 0.29; the ladder can't be aimed without it)
         ("diag", [py, "tools/diag_chip.py"], 420, f"DIAG_{t}.json"),
-        # 1500s covers bench_decode's own worst case (probe + 3x420s); the
+        # 1800s covers bench_decode's own worst case (probe + 4x420s); the
         # streamed per-point merge keeps finished points on an outer kill
-        ("decode", None, 1500, f"DECODE_{t}.json"),          # merge-aware
-        ("decode_pallas", None, 1500, f"DECODE_{t}_pallas.json"),
-        ("decode_pallas_int8", None, 1500, f"DECODE_{t}_pallas_int8.json"),
+        ("decode", None, 1800, f"DECODE_{t}.json"),          # merge-aware
+        ("decode_pallas", None, 1800, f"DECODE_{t}_pallas.json"),
+        ("decode_pallas_int8", None, 1800, f"DECODE_{t}_pallas_int8.json"),
+        ("decode_mixtral", None, 1800, f"DECODE_{t}_mixtral.json"),
+        # MoE decode-MLP isolation: XLA-fusion-vs-kernel evidence for the
+        # reference's moe_res_matmul / einsum_sec_sm_ecm counterparts
+        ("moe_decode", [py, "tools/bench_moe_decode.py"], 600,
+         f"MOE_DECODE_{t}.json"),
         ("kernels", None, None, f"KERNELS_{t}.json"),  # per-kernel splitter
         ("profile", [py, "tools/profile_train.py", "--quick"], 1200,
          f"PROFILE_{t}.json"),
@@ -315,9 +329,12 @@ def main():
             steps[name] = run_kernels_split(py, t, state)
         elif name.startswith("decode"):
             impl = {"decode": "xla", "decode_pallas": "pallas",
-                    "decode_pallas_int8": "pallas_int8"}[name]
+                    "decode_pallas_int8": "pallas_int8",
+                    "decode_mixtral": "xla"}[name]
+            model = "mixtral" if name == "decode_mixtral" else "llama"
             log(f"chip_sweep: {name} (cap {cap}s, merge-aware)")
-            steps[name] = run_decode_merged(py, t, state, impl, cap)
+            steps[name] = run_decode_merged(py, t, state, impl, cap,
+                                            model=model)
         else:
             log(f"chip_sweep: {name} (cap {cap}s)")
             steps[name] = run_capped(cmd, cap, artifact, log_name=name)
