@@ -59,14 +59,25 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, *rest,
 
     @pl.when(run)
     def _body():
+        # refs index the caches' NATIVE [B, S, Hkv, D] layout — no per-step
+        # transpose/pad of the whole cache on the host side (that copy cost
+        # O(S) per decode step and negated the kernel's block-skip win)
         q = q_ref[0, 0].astype(jnp.float32)     # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)     # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)     # [bk, D]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)  # [bk, D]
         if int8:
             # int8 cache: HBM->VMEM moved half the bytes; dequantize here
             # with the per-(position, kv head) absmax scales
-            k = k * ks_ref[0, 0][:, None]
-            v = v * vs_ref[0, 0][:, None]
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        # the trailing partial block (S % bk) arrives with UNSPECIFIED
+        # edge-padding bytes on hardware; scores are masked below (p == 0
+        # there) but 0 * NaN would still poison dot(p, v) — zero V's tail
+        # rows explicitly (K needs no guard: its garbage flows into s,
+        # which the where() below overwrites)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (v.shape[0], 1), 0) \
+            + ik * block_k
+        v = jnp.where(rows < s_total, v, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
@@ -155,23 +166,18 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         sm_scale = 1.0 / (D ** 0.5)
     bk = min(block_k, S)
 
-    # [B, Hkv, G|S, D] layouts for clean blocking
+    # q regrouped per kv head (tiny: [B, H, D]); K/V/scales are indexed in
+    # their NATIVE [B, S, Hkv, D] cache layout by the BlockSpecs — earlier
+    # versions swapaxes+padded the whole cache on the host EVERY step, an
+    # O(S) copy that dwarfed the kernel's own bandwidth savings
     qg = q.reshape(B, Hkv, G, D)
-    kt = jnp.swapaxes(k_cache, 1, 2)            # [B, Hkv, S, D]
-    vt = jnp.swapaxes(v_cache, 1, 2)
-    pad = (-S) % bk
-    if pad:
-        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
-    key_mask = jnp.pad(key_mask.astype(jnp.int32), ((0, 0), (0, pad)))
+    key_mask = key_mask.astype(jnp.int32)
     cidx = jnp.asarray(cache_index, jnp.int32).reshape(1)
     scales = []
     if int8:
-        for s in (k_scale, v_scale):
-            st = jnp.swapaxes(s.astype(jnp.float32), 1, 2)  # [B, Hkv, S]
-            scales.append(jnp.pad(st, ((0, 0), (0, 0), (0, pad))))
+        scales = [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
 
     nk = _ceil_div(S, bk)
 
@@ -179,23 +185,25 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # cache_index revisit the SAME already-resident block, so Pallas skips
     # the HBM->VMEM copy — decode bandwidth (the bottleneck) grows with the
     # REAL sequence length, not the padded cache. Compute for those steps is
-    # skipped by the pl.when in the kernel body.
+    # skipped by the pl.when in the kernel body. The trailing partial block
+    # (S % bk) is handled by Pallas' edge padding; compute masks it via
+    # ``cols < s_total``.
     def kv_idx(b, h, ik, cidx_ref):
-        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk), 0)
+        return (b, jnp.minimum(ik, cidx_ref[0] // bk), h, 0)
 
     def mask_idx(b, h, ik, cidx_ref):
         return (b, jnp.minimum(ik, cidx_ref[0] // bk))
 
     def scale_idx(b, h, ik, cidx_ref):
-        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk))
+        return (b, jnp.minimum(ik, cidx_ref[0] // bk), h)
 
     in_specs = [
         pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, bk, D), kv_idx),
-        pl.BlockSpec((1, 1, bk, D), kv_idx),
+        pl.BlockSpec((1, bk, 1, D), kv_idx),
+        pl.BlockSpec((1, bk, 1, D), kv_idx),
     ]
     if int8:
-        in_specs += [pl.BlockSpec((1, 1, bk), scale_idx)] * 2
+        in_specs += [pl.BlockSpec((1, bk, 1), scale_idx)] * 2
     in_specs.append(pl.BlockSpec((1, bk), mask_idx))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -214,5 +222,5 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         interpret=interpret,
-    )(cidx, qg, kt, vt, *scales, key_mask)
+    )(cidx, qg, k_cache, v_cache, *scales, key_mask)
     return out.reshape(B, H, D)

@@ -187,3 +187,29 @@ def test_int8_cache_gpt2_dequantizes():
     got = np.asarray(eng8.generate(ids, max_new_tokens=6, do_sample=False))
     agree = (got == base).mean()
     assert agree >= 0.9, f"gpt2 int8 cache diverged: {agree:.2f}"
+
+
+def test_no_per_step_cache_copy_in_host_prep():
+    """The kernel indexes the caches' native [B, S, Hkv, D] layout: the
+    traced program must contain NO transpose or pad of a cache-sized
+    operand (each was a full-cache copy per decode step — an O(S) host-side
+    cost that negated the kernel's block-skip bandwidth win)."""
+    import jax
+
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+    B, S, H, Hkv, D = 1, 96, 4, 2, 8
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+    kc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
+    vc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
+
+    jaxpr = jax.make_jaxpr(
+        lambda q, kc, vc: decode_attention(q, kc, vc, 17, block_k=32,
+                                           interpret=True))(q, kc, vc)
+    cache_elems = S * Hkv * D
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name in ("transpose", "pad"):
+            assert all(int(np.prod(v.aval.shape)) < cache_elems
+                       for v in eqn.invars), \
+                f"cache-sized {eqn.primitive.name} in decode host prep"
