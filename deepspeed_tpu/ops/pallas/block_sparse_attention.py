@@ -93,8 +93,11 @@ def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # compact [bq] residual (same HBM-traffic fix as flash_attention:
+        # the old 128-lane fp32 broadcast cost multiples of the q-block
+        # bytes per backward inner step)
         lse = jnp.where(l == 0.0, NEG_INF, m_scr[:] + jnp.log(l_safe))
-        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], 128))
+        lse_ref[0, 0] = lse[:, 0]
 
 
 def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -117,8 +120,8 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -159,8 +162,8 @@ def _bwd_dkv_kernel(q_idx, q_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -207,7 +210,7 @@ def _fwd(q, k, v, kv_idx, kv_cnt, sm_scale, causal, bq, bk, interpret):
         ],
         out_specs=[
             _spec_q(bq, D),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, a, *_: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, iq, a, *_: (b, h, iq)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -221,7 +224,7 @@ def _fwd(q, k, v, kv_idx, kv_cnt, sm_scale, causal, bq, bk, interpret):
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
         ],
         interpret=interpret,
     )(kv_idx, kv_cnt, q, k, v)
@@ -235,7 +238,6 @@ def _bwd(res, g, kv_idx, kv_cnt, q_idx, q_cnt, sm_scale, causal, bq, bk,
     B, H, T, D = q.shape
     nq, nk = T // bq, k.shape[2] // bk
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
 
     A = kv_idx.shape[-1]
     dq = pl.pallas_call(
@@ -249,8 +251,8 @@ def _bwd(res, g, kv_idx, kv_cnt, q_idx, q_cnt, sm_scale, causal, bq, bk,
                 _spec_kv(bk, D),
                 _spec_kv(bk, D),
                 _spec_q(bq, D),
-                pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, a, *_: (b, h, iq, 0)),
-                pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, a, *_: (b, h, iq, 0)),
+                pl.BlockSpec((1, 1, bq), lambda b, h, iq, a, *_: (b, h, iq)),
+                pl.BlockSpec((1, 1, bq), lambda b, h, iq, a, *_: (b, h, iq)),
             ],
             out_specs=_spec_q(bq, D),
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
@@ -264,6 +266,9 @@ def _bwd(res, g, kv_idx, kv_cnt, q_idx, q_cnt, sm_scale, causal, bq, bk,
     def qmap(b, h, ik, a, q_idx_ref, q_cnt_ref):
         return (b, h, q_idx_ref[h, ik, a], 0)
 
+    def qmap_1d(b, h, ik, a, q_idx_ref, q_cnt_ref):
+        return (b, h, q_idx_ref[h, ik, a])
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           bq=bq, bk=bk),
@@ -275,8 +280,8 @@ def _bwd(res, g, kv_idx, kv_cnt, q_idx, q_cnt, sm_scale, causal, bq, bk,
                 pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, a, *_: (b, h, ik, 0)),
                 pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, a, *_: (b, h, ik, 0)),
                 pl.BlockSpec((1, 1, bq, D), qmap),
-                pl.BlockSpec((1, 1, bq, 128), qmap),
-                pl.BlockSpec((1, 1, bq, 128), qmap),
+                pl.BlockSpec((1, 1, bq), qmap_1d),
+                pl.BlockSpec((1, 1, bq), qmap_1d),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, a, *_: (b, h, ik, 0)),
