@@ -528,8 +528,15 @@ class ZeroInfinityEngine:
 
     def _mark(self):
         if self.track_device_memory:
+            # count only arrays ALLOCATED SINCE step entry (by identity):
+            # jax.live_arrays() is process-global, so arrays kept alive by
+            # other code (earlier tests in the same pytest process, caches)
+            # must not count against this engine's streaming working set —
+            # and identity exclusion (vs a bytes delta) means a foreign
+            # array freed mid-step can't silently offset real engine usage
             live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                       for a in jax.live_arrays())
+                       for a in jax.live_arrays()
+                       if id(a) not in self._baseline_ids)
             self.last_peak_device_bytes = max(
                 self.last_peak_device_bytes, live)
 
@@ -584,6 +591,14 @@ class ZeroInfinityEngine:
     def train_batch(self, batch=None, data_iter=None):
         t0 = time.perf_counter()
         self.last_peak_device_bytes = 0
+        if self.track_device_memory:
+            import gc
+
+            gc.collect()  # drop unreferenced foreign arrays before baseline
+            # NOTE: engine-owned arrays that predate the step (edge params)
+            # are in the baseline too — the metric is step-ALLOCATED bytes
+            # (streamed blocks + activations + block grads)
+            self._baseline_ids = {id(a) for a in jax.live_arrays()}
 
         # Reference semantics (engine.py train_batch): from an iterator,
         # consume gas MICRO-batches (the dataloader yields micro*dp rows);

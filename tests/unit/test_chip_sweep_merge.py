@@ -1,0 +1,90 @@
+"""chip_sweep merge machinery: the window-accumulation logic every chip
+artifact depends on (a bug here burns a real chip window, so it gets CPU
+tests). Covers the round-5 additions: per-model decode runs (mixtral),
+artifact/metric/log naming, and truncation tolerance."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import chip_sweep  # noqa: E402
+
+
+def _fake_run(stdout, returncode=0):
+    def runner(cmd, capture_output=True, text=True, timeout=None, cwd=None):
+        return subprocess.CompletedProcess(cmd, returncode, stdout=stdout,
+                                           stderr="")
+    return runner
+
+
+def _point(b, p, tps=100.0):
+    return {"batch": b, "prompt": p, "new_tokens": 8, "ttft_ms": 1.0,
+            "decode_tokens_per_sec": tps}
+
+
+def test_merge_accumulates_across_windows_and_names_mixtral(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setattr(chip_sweep, "REPO", str(tmp_path))
+    state = {}
+    # window 1: two points stream, then the process is killed mid-line
+    out1 = (json.dumps({"point": _point(1, 128)}) + "\n"
+            + json.dumps({"point": _point(8, 512)}) + "\n"
+            + '{"point": {"batch": 32, "pro')  # truncated by the kill
+    monkeypatch.setattr(chip_sweep.subprocess, "run", _fake_run(out1))
+    rec1 = chip_sweep.run_decode_merged("py", "rXX", state, "xla",
+                                        model="mixtral")
+    assert rec1["points_captured"] == 2 and not rec1["ok"]
+    art = tmp_path / "DECODE_rXX_mixtral.json"
+    assert art.exists()
+    assert json.loads(art.read_text())["metric"] == "mixtral_small_decode"
+    # tee log is per-model: never clobbers the llama decode log
+    assert (tmp_path / "chip_logs" / "decode_mixtral_xla.log").exists()
+    assert not (tmp_path / "chip_logs" / "decode_xla.log").exists()
+
+    # window 2: remaining points arrive; merge completes without losing
+    # window 1's, and a repeated point overwrites (fresher measurement)
+    out2 = (json.dumps({"point": _point(8, 512, tps=140.0)}) + "\n"
+            + json.dumps({"point": _point(32, 1024)}) + "\n"
+            + json.dumps({"point": _point(64, 2048)}) + "\n"
+            + json.dumps({"points": [], "point_errors": ""}) + "\n")
+    monkeypatch.setattr(chip_sweep.subprocess, "run", _fake_run(out2))
+    rec2 = chip_sweep.run_decode_merged("py", "rXX", state, "xla",
+                                        model="mixtral")
+    assert rec2["ok"] and rec2["points_captured"] == 4
+    merged = json.loads(art.read_text())["points"]
+    assert len(merged) == 4
+    by_key = {(p["batch"], p["prompt"]): p for p in merged}
+    assert by_key[(8, 512)]["decode_tokens_per_sec"] == 140.0
+
+
+def test_llama_artifact_naming_and_impl_suffix(tmp_path, monkeypatch):
+    monkeypatch.setattr(chip_sweep, "REPO", str(tmp_path))
+    out = json.dumps({"point": _point(1, 128)}) + "\n"
+    monkeypatch.setattr(chip_sweep.subprocess, "run", _fake_run(out))
+    state = {}
+    chip_sweep.run_decode_merged("py", "rXX", state, "pallas")
+    art = tmp_path / "DECODE_rXX_pallas.json"
+    assert art.exists()
+    rec = json.loads(art.read_text())
+    assert rec["metric"] == "llama400m_decode" and rec["impl"] == "pallas"
+    # state keys are model-scoped: a mixtral run never pollutes llama's
+    assert set(state) == {"decode_points_pallas"}
+
+
+def test_plan_impl_mapping_covers_every_decode_step():
+    """Every decode step name in the sweep plan must resolve in the
+    impl/model mapping (a KeyError here would abort a live window)."""
+    import re
+
+    src = open(os.path.join(REPO, "tools", "chip_sweep.py")).read()
+    plan_names = re.findall(r'\("((?:decode)[a-z0-9_]*)", None', src)
+    assert len(plan_names) >= 4
+    mapping = {"decode": "xla", "decode_pallas": "pallas",
+               "decode_pallas_int8": "pallas_int8", "decode_mixtral": "xla"}
+    for name in plan_names:
+        assert name in mapping, name
