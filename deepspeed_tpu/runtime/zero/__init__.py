@@ -6,7 +6,8 @@ from .partition import (  # noqa: F401
     shard_params,
     state_shardings,
 )
-from .tiling import TiledLinear, split_tensor_along_last_dim  # noqa: F401
+from .tiling import (TiledLinear, TiledLinearReturnBias,  # noqa: F401
+                     split_tensor_along_last_dim)
 from .estimator import (  # noqa: F401
     estimate_zero2_model_states_mem_needs,
     estimate_zero2_model_states_mem_needs_all_cold,

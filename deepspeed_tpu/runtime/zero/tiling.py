@@ -87,6 +87,44 @@ class TiledLinear(nn.Module):
         return out
 
 
+class TiledLinearReturnBias(TiledLinear):
+    """Megatron-style deferred-bias variant (reference ``tiling.py:257``):
+    returns ``(y_without_bias, bias)`` so the caller can fuse the bias add
+    into a later op (Megatron linears return their bias the same way).
+    ``bias`` is the concatenated per-tile-column bias ``[features]`` (None
+    when ``use_bias=False``)."""
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if in_features % self.in_splits:
+            raise ValueError(f"in_features {in_features} not divisible by "
+                             f"in_splits {self.in_splits}")
+        if self.features % self.out_splits:
+            raise ValueError(f"features {self.features} not divisible by "
+                             f"out_splits {self.out_splits}")
+        rt = in_features // self.in_splits
+        ct = self.features // self.out_splits
+        kinit = self.kernel_init or nn.initializers.variance_scaling(
+            1.0 / self.in_splits, "fan_in", "truncated_normal")
+        dt = self.dtype or x.dtype
+        x = x.astype(dt)
+        xs = jnp.split(x, self.in_splits, axis=-1)
+        outs, biases = [], []
+        for c in range(self.out_splits):
+            acc = None
+            for r in range(self.in_splits):
+                w = self.param(f"tile_{r}_{c}", kinit, (rt, ct), jnp.float32)
+                part = xs[r] @ w.astype(dt)
+                acc = part if acc is None else acc + part
+            if self.use_bias:
+                biases.append(self.param(f"bias_{c}", self.bias_init, (ct,),
+                                         jnp.float32).astype(dt))
+            outs.append(acc)
+        bias = jnp.concatenate(biases) if biases else None
+        return jnp.concatenate(outs, axis=-1), bias
+
+
 def split_tensor_along_last_dim(tensor, num_partitions: int,
                                 contiguous_split_chunks: bool = False):
     """Parity helper (reference ``tiling.py`` uses Megatron's splitter)."""

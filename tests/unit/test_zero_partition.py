@@ -135,3 +135,31 @@ def test_tiled_linear_matches_dense_and_shards_leafwise():
     y_bf = TiledLinear(features=24, in_splits=4, out_splits=3,
                        dtype=jnp.bfloat16).apply({"params": tparams}, x)
     assert y_bf.dtype == jnp.bfloat16
+
+
+def test_tiled_linear_return_bias_defers_bias():
+    """``TiledLinearReturnBias`` (reference ``zero/tiling.py:257``): same
+    tiled matmul but the bias is RETURNED, not added — y + bias must equal
+    the plain TiledLinear output with identical params."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.zero import TiledLinear, TiledLinearReturnBias
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 16), jnp.float32)
+    tl = TiledLinear(features=24, in_splits=4, out_splits=3)
+    params = tl.init(jax.random.PRNGKey(0), x)
+    y_fused = tl.apply(params, x)
+
+    rb = TiledLinearReturnBias(features=24, in_splits=4, out_splits=3)
+    y, bias = rb.apply(params, x)  # identical param structure by design
+    assert bias.shape == (24,)
+    np.testing.assert_allclose(np.asarray(y + bias), np.asarray(y_fused),
+                               rtol=1e-6, atol=1e-6)
+
+    rb_nb = TiledLinearReturnBias(features=24, in_splits=4, out_splits=3,
+                                  use_bias=False)
+    y2, bias2 = rb_nb.apply(
+        rb_nb.init(jax.random.PRNGKey(1), x), x)
+    assert bias2 is None and y2.shape == (4, 24)
