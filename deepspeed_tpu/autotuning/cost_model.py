@@ -35,6 +35,21 @@ def flatten_config(cfg: Dict, prefix: str = "") -> Dict[str, float]:
     return out
 
 
+def rank_by_cost_model(measured, cand_feats, min_measured: int = 6):
+    """Order candidate indices predicted-best-first, or None when the model
+    has too few measurements to rank (callers keep declaration order).
+    ``measured``: [(features, score)]; shared by ``mfu_tuner`` and
+    ``tools/attack_mfu.py`` so the ranking core can't drift between the
+    library search and the on-chip attack."""
+    if len(measured) < min_measured or len(cand_feats) <= 1:
+        return None
+    model = RidgeCostModel().fit([m[0] for m in measured],
+                                 [m[1] for m in measured])
+    preds = model.predict(cand_feats)
+    return [i for _, i in sorted(
+        zip(preds, range(len(cand_feats))), key=lambda t: -t[0])]
+
+
 class RidgeCostModel:
     """fit(X, y) / predict(X) with the expanded feature map; y is normalized
     to its max (the reference does the same before fitting)."""

@@ -26,7 +26,6 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.logging import log_dist, logger
-from .cost_model import RidgeCostModel
 
 #: The full lever space (reference core space analog; tools/attack_mfu.py
 #: walks the same axes on the live chip). ``bg`` is (micro_batch, gas).
@@ -182,15 +181,15 @@ class MFUTuner:
         """Current value first; the rest predicted-best-first once the cost
         model has enough measurements (reference
         ``find_estimated_top_configs``)."""
+        from .cost_model import rank_by_cost_model
+
         rest = [v for v in values if v != cur_spec[axis]]
-        measured = self._measured()
-        if len(measured) >= self.prune_after and len(rest) > 1:
-            model = RidgeCostModel().fit([m[0] for m in measured],
-                                         [m[1] for m in measured])
-            preds = model.predict(
-                [spec_features({**cur_spec, axis: v}) for v in rest])
-            rest = [v for _, v in sorted(
-                zip(preds, rest), key=lambda t: -t[0])]
+        ranked = rank_by_cost_model(
+            self._measured(),
+            [spec_features({**cur_spec, axis: v}) for v in rest],
+            min_measured=self.prune_after)
+        if ranked is not None:
+            rest = [rest[i] for i in ranked]
         return [cur_spec[axis]] + rest
 
     def tune(self, budget_evals: int = 64,

@@ -65,3 +65,52 @@ def test_bench_unreachable_backend_still_emits_json():
         assert rec["detail"]["cached_value"] == cached["value"]
         assert "chip-window capture" in rec["detail"]["source"]
         assert rec["detail"]["artifact"] == cached["_artifact"]
+
+
+def test_attack_axis_order_ranks_by_cost_model():
+    """attack_mfu's in-axis ordering: with >=6 measured results the ridge
+    model must rank the known-better value first; with fewer, declaration
+    order is kept (current value always first either way)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import attack_mfu
+
+    def rec(batch, gas, policy, tflops):
+        return {"tflops": tflops,
+                "spec": {"tag": "t", "batch": batch, "gas": gas,
+                         "policy": policy, "fq": 512, "fk": 512,
+                         "lchunk": 0, "padam": False, "attn": "flash"}}
+
+    cur = dict(attack_mfu.DEFAULT)
+    # 6 measurements with a clean monotone signal: bigger batch*gas wins
+    state = {"results": {
+        f"k{i}": rec(b, g, "dots", 10.0 * b * g)
+        for i, (b, g) in enumerate(
+            [(8, 8), (16, 4), (16, 8), (32, 4), (8, 16), (8, 4)])}}
+    order = attack_mfu.axis_order(state, cur, "bg",
+                                  attack_mfu.AXES["bg"])
+    assert order[0] == cur["bg"]            # incumbent always first
+    # the clearly-worst value (b*g = 64, every other rest value is 128)
+    # must be ranked last by the fitted model
+    assert order[-1] == (16, 4)
+    # sparse state: declaration order preserved
+    order2 = attack_mfu.axis_order({"results": {}}, cur, "bg",
+                                   attack_mfu.AXES["bg"])
+    assert order2 == [cur["bg"]] + [v for v in attack_mfu.AXES["bg"]
+                                    if v != cur["bg"]]
+
+
+def test_attack_resumes_walk_from_persisted_best():
+    """A resumed attack window must restart the descent AT the best
+    persisted config, not at DEFAULT (else every window re-probes
+    single-lever neighbors of DEFAULT and the search stalls)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import attack_mfu
+
+    spec = {"tag": "t", "batch": 16, "gas": 8, "policy": "nothing",
+            "fq": 1024, "fk": 512, "lchunk": 4096, "padam": True,
+            "attn": "xla"}
+    cfg = attack_mfu.cfg_from_spec(spec)
+    assert cfg == {"bg": (16, 8), "policy": "nothing", "fq": 1024,
+                   "fk": 512, "lchunk": 4096, "padam": True, "attn": "xla"}
+    # round trip through spec_of: the persisted form reconstructs exactly
+    assert attack_mfu.cfg_from_spec(attack_mfu.spec_of(cfg)) == cfg

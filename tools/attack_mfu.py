@@ -161,6 +161,40 @@ def maybe_commit_best(tag, state):
     return best_k
 
 
+def cfg_from_spec(spec):
+    """Rebuild the axes-form config from measure()'s persisted flat spec."""
+    return {"bg": (spec["batch"], spec.get("gas", 1)),
+            "fq": spec.get("fq", 512), "fk": spec.get("fk", 512),
+            "lchunk": spec.get("lchunk", 0), "policy": spec["policy"],
+            "padam": spec.get("padam", False),
+            "attn": spec.get("attn", "flash")}
+
+
+def axis_order(state, cur, axis, values):
+    """Current value first; rest predicted-best-first once the shared ridge
+    cost model (autotuning/cost_model.py — same core as MFUTuner, the
+    library form of this search) has enough measurements. On a short chip
+    window the next evaluation is the likeliest winner, not declaration
+    order."""
+    rest = [v for v in values if v != cur[axis]]
+    try:
+        from deepspeed_tpu.autotuning.cost_model import rank_by_cost_model
+        from deepspeed_tpu.autotuning.mfu_tuner import spec_features
+
+        measured = [(spec_features(cfg_from_spec(r["spec"])), r["tflops"])
+                    for r in state["results"].values()
+                    if r.get("tflops") and r.get("spec")]
+        ranked = rank_by_cost_model(
+            measured, [spec_features({**cur, axis: v}) for v in rest])
+        if ranked is not None:
+            rest = [rest[i] for i in ranked]
+    except Exception as e:
+        # ordering is an optimization; never kill the attack — but say so,
+        # else integration breakage is indistinguishable from a cold model
+        log(f"attack: axis_order fallback to declaration order: {e!r}")
+    return [cur[axis]] + rest
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="r04")
@@ -195,19 +229,28 @@ def main():
 
     cur = dict(DEFAULT)
     best_rec = None
-    # resume: restart the walk from the best persisted measurement
+    # resume: restart the walk FROM the best persisted measurement — both
+    # the acceptance threshold (best_rec) and the walk position (cur);
+    # r5 review: cur previously stayed DEFAULT, so a resumed window spent
+    # its budget re-probing single-lever neighbors of DEFAULT instead of
+    # the best config's neighborhood
     for k, rec in state["results"].items():
         if rec.get("tflops") and (best_rec is None
                                   or rec["tflops"] > best_rec["tflops"]):
             best_rec = rec
+    if best_rec is not None and best_rec.get("spec"):
+        try:
+            cur = cfg_from_spec(best_rec["spec"])
+        except KeyError:
+            pass  # old-format record: keep DEFAULT
     # coordinate descent, cycling axes until the budget ends or no axis
-    # improves; evaluation order within an axis: current value first
+    # improves; evaluation order within an axis: current value first,
+    # rest cost-model-ranked
     improved = True
     while improved and time.time() - t0 < args.budget_s:
         improved = False
         for axis, values in AXES.items():
-            order = [cur[axis]] + [v for v in values if v != cur[axis]]
-            for v in order:
+            for v in axis_order(state, cur, axis, values):
                 if time.time() - t0 > args.budget_s:
                     break
                 trial = dict(cur, **{axis: v})
