@@ -14,8 +14,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import (cache_attention_bias, cross_entropy_loss, dot_product_attention,
-                     init_kv_cache, make_causal_mask, read_kv_cache,
+from .layers import (cache_attention_bias, cached_attention_xla,
+                     cross_entropy_loss, dot_product_attention,
+                     init_kv_cache, make_causal_mask,
                      shift_labels, update_kv_cache)
 
 
@@ -61,9 +62,9 @@ class GPT2Attention(nn.Module):
         v = v.reshape(B, T, H, D)
         if layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
-            k, v = read_kv_cache(layer_cache, x.dtype)
-            bias = cache_attention_bias(T, k.shape[1], cache_index, key_mask=mask)
-            out = dot_product_attention(q, k, v, bias=bias, causal=False)
+            # head-major XLA math: no cache-sized transpose per step
+            out = cached_attention_xla(q, layer_cache, cache_index,
+                                       key_mask=mask)
         else:
             rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and
                                                not deterministic) else None

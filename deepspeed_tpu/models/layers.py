@@ -189,9 +189,15 @@ def init_kv_cache(batch: int, max_len: int, num_kv_heads: int, head_dim: int,
 
     Counterpart of the reference decode kernels' persistent KV workspace
     (``csrc/transformer/inference/csrc/pt_binding.cpp`` ``softmax_context``
-    appends into a preallocated cache). Layout ``[L?, B, S, Hkv, D]`` — the
-    leading layer axis is present when the model scans its blocks, so the
-    cache threads through ``nn.scan`` as per-layer xs/ys.
+    appends into a preallocated cache). Layout ``[L?, B, Hkv, S, D]`` —
+    head-major so the Pallas decode kernel's ``(1, 1, block_k, D)`` blocks
+    tile cleanly (Mosaic tiles the last two dims; a seq-major layout would
+    either pad 1-sized minor dims ~16-32x in VMEM or force an O(S)
+    transpose of the whole cache every decode step). Appends transpose
+    only the NEW tokens (O(T), not O(S)); ``read_kv_cache`` returns the
+    seq-major view the XLA attention math uses. The leading layer axis is
+    present when the model scans its blocks, so the cache threads through
+    ``nn.scan`` as per-layer xs/ys.
     """
     if dtype == jnp.int8:
         # int8 cache: values quantized per (position, kv head) with an
@@ -200,8 +206,8 @@ def init_kv_cache(batch: int, max_len: int, num_kv_heads: int, head_dim: int,
         # fp32; the Pallas decode kernel dequantizes per block in VMEM, the
         # XLA fallback dequantizes on read. Counterpart of the reference's
         # int8 inference kernels (SURVEY row 46 "int8").
-        shape = (batch, max_len, num_kv_heads, head_dim)
-        sshape = (batch, max_len, num_kv_heads)
+        shape = (batch, num_kv_heads, max_len, head_dim)
+        sshape = (batch, num_kv_heads, max_len)
         if n_layers is not None:
             shape = (n_layers,) + shape
             sshape = (n_layers,) + sshape
@@ -209,14 +215,15 @@ def init_kv_cache(batch: int, max_len: int, num_kv_heads: int, head_dim: int,
                 "v": jnp.zeros(shape, jnp.int8),
                 "k_scale": jnp.zeros(sshape, jnp.float32),
                 "v_scale": jnp.zeros(sshape, jnp.float32)}
-    shape = (batch, max_len, num_kv_heads, head_dim)
+    shape = (batch, num_kv_heads, max_len, head_dim)
     if n_layers is not None:
         shape = (n_layers,) + shape
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def _quantize_kv(x):
-    """[B, T, Hkv, D] -> (int8 values, fp32 absmax-per-(pos, head) scales)."""
+    """[..., D] -> (int8 values, fp32 absmax-per-row scales over the last
+    axis); used on head-major [B, Hkv, T, D] cache slices."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
     scale = amax / 127.0
     q = jnp.round(x.astype(jnp.float32)
@@ -230,24 +237,69 @@ def dequantize_kv(q, scale, dtype=jnp.float32):
 
 
 def read_kv_cache(layer_cache, dtype):
-    """Materialize ``(k, v)`` in ``dtype`` from a cache dict — THE accessor
-    every attention implementation must use (an int8 cache dequantizes here;
-    reading ``layer_cache["k"]`` directly would hand raw int8 codes to the
-    attention math)."""
+    """Materialize seq-major ``(k, v)`` ``[B, S, Hkv, D]`` in ``dtype`` from
+    a (head-major) cache dict (an int8 cache dequantizes here; reading
+    ``layer_cache["k"]`` directly would hand raw int8 codes — in cache
+    layout — to the attention math). NOTE: this materializes a transposed
+    view of the WHOLE cache — hot decode paths should use
+    ``cached_attention_xla`` (head-major math, no transpose) or the Pallas
+    decode kernel instead."""
     if "k_scale" in layer_cache:
-        return (dequantize_kv(layer_cache["k"], layer_cache["k_scale"], dtype),
-                dequantize_kv(layer_cache["v"], layer_cache["v_scale"], dtype))
-    return layer_cache["k"].astype(dtype), layer_cache["v"].astype(dtype)
+        k = dequantize_kv(layer_cache["k"], layer_cache["k_scale"], dtype)
+        v = dequantize_kv(layer_cache["v"], layer_cache["v_scale"], dtype)
+    else:
+        k = layer_cache["k"].astype(dtype)
+        v = layer_cache["v"].astype(dtype)
+    return jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)
+
+
+def cached_attention_xla(q, layer_cache, cache_index=None, key_mask=None,
+                         window=None, scale=None, bias=None):
+    """XLA attention over the head-major KV cache with NO cache-sized
+    transpose: K/V stay ``[B, Hkv, S, D]`` end to end (GQA repeats over the
+    head axis as a broadcast the compiler folds into the einsum; the
+    seq-major contraction ``bqhd,bhkd->bhqk`` is layout-identical work).
+    ``q``: ``[B, T, H, D]``; returns ``[B, T, H, D]``. Pass either a full
+    precomputed additive ``bias`` (``[B, H, T, S]``-broadcastable, e.g. the
+    generic transformer's cache+ALiBi composite) OR ``cache_index`` (+
+    optional ``key_mask``/``window``) to build the standard cache bias."""
+    B, T, H, D = q.shape
+    if "k_scale" in layer_cache:
+        k = dequantize_kv(layer_cache["k"], layer_cache["k_scale"], q.dtype)
+        v = dequantize_kv(layer_cache["v"], layer_cache["v_scale"], q.dtype)
+    else:
+        k = layer_cache["k"].astype(q.dtype)
+        v = layer_cache["v"].astype(q.dtype)
+    Hkv, S = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    if rep > 1:  # GQA: expand over the head axis [B, Hkv*rep, S, D]
+        k = jnp.broadcast_to(k[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, H, S, D)
+        v = jnp.broadcast_to(v[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, H, S, D)
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is None:
+        bias = cache_attention_bias(T, S, cache_index, key_mask=key_mask,
+                                    window=window)
+    logits = logits + bias
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bqhd", probs, v)
 
 
 def update_kv_cache(layer_cache, k, v, cache_index):
     """Append ``[B, T, Hkv, D]`` keys/values at ``cache_index`` (traced ok).
-    An int8 cache (see ``init_kv_cache``) quantizes at append time."""
-    idx = (0, cache_index, 0, 0)
+    Only the NEW tokens are transposed into the head-major cache layout
+    (O(T) per call — during decode T=1). An int8 cache (see
+    ``init_kv_cache``) quantizes at append time."""
+    k = jnp.swapaxes(k, 1, 2)  # [B, Hkv, T, D]
+    v = jnp.swapaxes(v, 1, 2)
+    idx = (0, 0, cache_index, 0)
     if "k_scale" in layer_cache:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        sidx = (0, cache_index, 0)
+        sidx = (0, 0, cache_index)
         return {
             "k": jax.lax.dynamic_update_slice(layer_cache["k"], kq, idx),
             "v": jax.lax.dynamic_update_slice(layer_cache["v"], vq, idx),

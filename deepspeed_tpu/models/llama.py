@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .layers import (RMSNorm, apply_rotary, cache_attention_bias,
-                     cross_entropy_loss, lm_head_output, read_kv_cache,
+                     cached_attention_xla,
+                     cross_entropy_loss, lm_head_output,
                      dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache)
@@ -141,13 +142,10 @@ class LlamaAttention(nn.Module):
                                        v_scale=layer_cache.get("v_scale"),
                                        window=cfg.sliding_window)[:, None]
             else:
-                kc, vc = read_kv_cache(layer_cache, x.dtype)
-                k = repeat_kv(kc, H // Hkv)
-                v = repeat_kv(vc, H // Hkv)
-                bias = cache_attention_bias(T, k.shape[1], cache_index,
-                                            key_mask=mask,
-                                            window=cfg.sliding_window)
-                out = dot_product_attention(q, k, v, bias=bias, causal=False)
+                # head-major XLA math: no cache-sized transpose per step
+                out = cached_attention_xla(q, layer_cache, cache_index,
+                                           key_mask=mask,
+                                           window=cfg.sliding_window)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)

@@ -22,9 +22,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .layers import (cache_attention_bias, cross_entropy_loss,
+from .layers import (cache_attention_bias, cached_attention_xla,
+                     cross_entropy_loss,
                      key_mask_to_bias,
-                     dot_product_attention, read_kv_cache,
+                     dot_product_attention,
                      lm_head_output,
                      init_kv_cache, repeat_kv, resolve_remat_policy,
                      rotary_embedding, shift_labels, update_kv_cache)
@@ -214,11 +215,10 @@ class GenericAttention(nn.Module):
                                        v_scale=layer_cache.get("v_scale"),
                                        sm_scale=cfg.attention_scale)[:, None]
             else:
-                kc, vc = read_kv_cache(layer_cache, x.dtype)
-                k = repeat_kv(kc, H // Hkv)
-                v = repeat_kv(vc, H // Hkv)
-                out = dot_product_attention(q, k, v, bias=bias, causal=False,
-                                            scale=cfg.attention_scale)
+                # head-major XLA math (no cache-sized transpose); bias here
+                # is the model-level composite (cache causality + ALiBi)
+                out = cached_attention_xla(q, layer_cache, bias=bias,
+                                           scale=cfg.attention_scale)
         else:
             k = repeat_kv(k, H // Hkv)
             v = repeat_kv(v, H // Hkv)
@@ -350,7 +350,7 @@ class TransformerModel(nn.Module):
         # causality in via cache_attention_bias; the full path lets the
         # attention core apply causality.
         kv_len = T if cache is None else \
-            jax.tree_util.tree_leaves(cache)[0].shape[-3]
+            jax.tree_util.tree_leaves(cache)[0].shape[-2]  # [.., Hkv, S, D]
         bias = None
         if cache is not None:
             if not cfg.causal:

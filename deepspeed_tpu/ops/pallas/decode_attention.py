@@ -59,17 +59,17 @@ def _decode_kernel(cidx_ref, q_ref, k_ref, v_ref, *rest,
 
     @pl.when(run)
     def _body():
-        # refs index the caches' NATIVE [B, S, Hkv, D] layout — no per-step
-        # transpose/pad of the whole cache on the host side (that copy cost
-        # O(S) per decode step and negated the kernel's block-skip win)
+        # refs index the caches' HEAD-MAJOR [B, Hkv, S, D] layout (see
+        # models/layers.py init_kv_cache): blocks are (1, 1, bk, D) —
+        # well-tiled minor dims AND zero host-side cache transforms
         q = q_ref[0, 0].astype(jnp.float32)     # [G, D]
-        k = k_ref[0, :, 0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)  # [bk, D]
+        k = k_ref[0, 0].astype(jnp.float32)     # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)     # [bk, D]
         if int8:
             # int8 cache: HBM->VMEM moved half the bytes; dequantize here
-            # with the per-(position, kv head) absmax scales
-            k = k * ks_ref[0, :, 0][:, None]
-            v = v * vs_ref[0, :, 0][:, None]
+            # with the per-(kv head, position) absmax scales
+            k = k * ks_ref[0, 0][:, None]
+            v = v * vs_ref[0, 0][:, None]
         # the trailing partial block (S % bk) arrives with UNSPECIFIED
         # edge-padding bytes on hardware; scores are masked below (p == 0
         # there) but 0 * NaN would still poison dot(p, v) — zero V's tail
@@ -131,11 +131,12 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     """Single-position cached attention.
 
     q: ``[B, H, D]`` (the one new token's query heads), k_cache/v_cache:
-    ``[B, S, Hkv, D]``, ``cache_index``: scalar count of already-cached
-    tokens (the new token sits at that position), ``key_mask``: ``[B, S]``
-    1 = real token. Returns ``[B, H, D]``.
+    head-major ``[B, Hkv, S, D]`` (the ``init_kv_cache`` layout),
+    ``cache_index``: scalar count of already-cached tokens (the new token
+    sits at that position), ``key_mask``: ``[B, S]`` 1 = real token.
+    Returns ``[B, H, D]``.
 
-    An int8 cache passes ``k_scale``/``v_scale`` ``[B, S, Hkv]`` (see
+    An int8 cache passes ``k_scale``/``v_scale`` ``[B, Hkv, S]`` (see
     ``models/layers.py init_kv_cache``): the kernel reads int8 from HBM —
     half the decode bandwidth — and dequantizes per block in VMEM. The
     reference's int8 inference kernels dequantize in shared memory the same
@@ -154,11 +155,13 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                 from ...models.layers import dequantize_kv
                 k_cache = dequantize_kv(k_cache, k_scale, q.dtype)
                 v_cache = dequantize_kv(v_cache, v_scale, q.dtype)
-            return _reference_decode(q, k_cache, v_cache, cache_index,
-                                     key_mask, sm_scale, window=window)
+            return _reference_decode(
+                q, jnp.swapaxes(k_cache, 1, 2),
+                jnp.swapaxes(v_cache, 1, 2), cache_index, key_mask,
+                sm_scale, window=window)
         interpret = not on_tpu
     B, H, D = q.shape
-    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, S = k_cache.shape[1], k_cache.shape[2]
     if H % Hkv:
         raise ValueError(f"query heads {H} must divide into kv heads {Hkv}")
     G = H // Hkv
@@ -166,10 +169,12 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         sm_scale = 1.0 / (D ** 0.5)
     bk = min(block_k, S)
 
-    # q regrouped per kv head (tiny: [B, H, D]); K/V/scales are indexed in
-    # their NATIVE [B, S, Hkv, D] cache layout by the BlockSpecs — earlier
-    # versions swapaxes+padded the whole cache on the host EVERY step, an
-    # O(S) copy that dwarfed the kernel's own bandwidth savings
+    # q regrouped per kv head (tiny: [B, H, D]); K/V/scales arrive in the
+    # HEAD-MAJOR [B, Hkv, S, D] cache layout (models/layers.py
+    # init_kv_cache), so blocks are (1, 1, bk, D) — well-tiled minor dims
+    # — and the host side does NO cache-sized transform at all (earlier
+    # versions swapaxes+padded the whole cache EVERY step, an O(S) copy
+    # that dwarfed the kernel's own bandwidth savings)
     qg = q.reshape(B, Hkv, G, D)
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
@@ -189,21 +194,21 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # (S % bk) is handled by Pallas' edge padding; compute masks it via
     # ``cols < s_total``.
     def kv_idx(b, h, ik, cidx_ref):
-        return (b, jnp.minimum(ik, cidx_ref[0] // bk), h, 0)
+        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk), 0)
 
     def mask_idx(b, h, ik, cidx_ref):
         return (b, jnp.minimum(ik, cidx_ref[0] // bk))
 
     def scale_idx(b, h, ik, cidx_ref):
-        return (b, jnp.minimum(ik, cidx_ref[0] // bk), h)
+        return (b, h, jnp.minimum(ik, cidx_ref[0] // bk))
 
     in_specs = [
         pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, bk, 1, D), kv_idx),
-        pl.BlockSpec((1, bk, 1, D), kv_idx),
+        pl.BlockSpec((1, 1, bk, D), kv_idx),
+        pl.BlockSpec((1, 1, bk, D), kv_idx),
     ]
     if int8:
-        in_specs += [pl.BlockSpec((1, bk, 1), scale_idx)] * 2
+        in_specs += [pl.BlockSpec((1, 1, bk), scale_idx)] * 2
     in_specs.append(pl.BlockSpec((1, bk), mask_idx))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -12,9 +12,11 @@ from deepspeed_tpu.ops.pallas.decode_attention import (_reference_decode,
 
 
 def _ref(q, kc, vc, cache_index, mask):
-    # the kernel module's own XLA reference (the off-TPU fallback): parity
-    # asserts kernel == fallback so the two can never drift
-    return _reference_decode(q, kc, vc, cache_index, mask,
+    # the kernel module's own XLA reference (the off-TPU fallback, which
+    # takes SEQ-major [B, S, Hkv, D]): parity asserts kernel == fallback
+    # so the two can never drift. kc/vc here are head-major cache-layout.
+    return _reference_decode(q, jnp.swapaxes(kc, 1, 2),
+                             jnp.swapaxes(vc, 1, 2), cache_index, mask,
                              1.0 / (q.shape[-1] ** 0.5))
 
 
@@ -24,8 +26,8 @@ def test_parity_vs_xla_decode_path(H, Hkv, cache_index):
     rs = np.random.RandomState(0)
     B, S, D = 2, 64, 16
     q = jnp.asarray(rs.randn(B, H, D).astype(np.float32))
-    kc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
-    vc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
+    kc = jnp.asarray(rs.randn(B, Hkv, S, D).astype(np.float32))
+    vc = jnp.asarray(rs.randn(B, Hkv, S, D).astype(np.float32))
     mask = np.ones((B, S), np.int32)
     if cache_index > 3:
         # left padding on row 0 (a row with EVERY visible key masked is
@@ -44,8 +46,8 @@ def test_bf16_cache_and_uneven_blocks():
     rs = np.random.RandomState(1)
     B, S, H, Hkv, D = 1, 48, 4, 2, 8
     q = jnp.asarray(rs.randn(B, H, D).astype(np.float32), jnp.bfloat16)
-    kc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.bfloat16)
-    vc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.bfloat16)
+    kc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.bfloat16)
+    vc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.bfloat16)
     got = decode_attention(q, kc, vc, 17, block_k=32, interpret=True)
     ref = _ref(q.astype(jnp.float32), kc.astype(jnp.float32),
                vc.astype(jnp.float32), 17, None)
@@ -130,8 +132,8 @@ def test_int8_cache_kernel_parity():
     rs = np.random.RandomState(5)
     B, S, H, Hkv, D = 2, 64, 8, 2, 16
     q = jnp.asarray(rs.randn(B, H, D).astype(np.float32))
-    kc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
-    vc = jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32))
+    kc = jnp.asarray(rs.randn(B, Hkv, S, D).astype(np.float32))
+    vc = jnp.asarray(rs.randn(B, Hkv, S, D).astype(np.float32))
     kq, ks = _quantize_kv(kc)
     vq, vs = _quantize_kv(vc)
     got = decode_attention(q, kq, vq, 33, k_scale=ks, v_scale=vs,
@@ -190,10 +192,12 @@ def test_int8_cache_gpt2_dequantizes():
 
 
 def test_no_per_step_cache_copy_in_host_prep():
-    """The kernel indexes the caches' native [B, S, Hkv, D] layout: the
-    traced program must contain NO transpose or pad of a cache-sized
-    operand (each was a full-cache copy per decode step — an O(S) host-side
-    cost that negated the kernel's block-skip bandwidth win)."""
+    """The kernel indexes the head-major [B, Hkv, S, D] cache layout
+    directly: the traced program must contain NO transpose or pad of a
+    cache-sized operand (each was a full-cache copy per decode step — an
+    O(S) host-side cost that negated the kernel's block-skip bandwidth
+    win; the layout also keeps block minor dims (bk, D) well-tiled for
+    Mosaic, where seq-major indexing would pad 1-sized minor dims)."""
     import jax
 
     from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
@@ -201,8 +205,8 @@ def test_no_per_step_cache_copy_in_host_prep():
     B, S, H, Hkv, D = 1, 96, 4, 2, 8
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
-    kc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
-    vc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
+    kc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32)
+    vc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32)
 
     jaxpr = jax.make_jaxpr(
         lambda q, kc, vc: decode_attention(q, kc, vc, 17, block_k=32,
@@ -213,3 +217,45 @@ def test_no_per_step_cache_copy_in_host_prep():
             assert all(int(np.prod(v.aval.shape)) < cache_elems
                        for v in eqn.invars), \
                 f"cache-sized {eqn.primitive.name} in decode host prep"
+
+
+def test_no_cache_sized_copy_in_xla_decode_path_either():
+    """The DEFAULT (xla) decode path must also be free of cache-sized
+    transposes/pads: cached_attention_xla computes head-major end to end
+    (the GQA head broadcast predates this and is the XLA path's known
+    repeat_kv cost; a transpose on top would be pure regression)."""
+    import jax
+
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)  # decode_attention_impl defaults xla
+    model = LlamaForCausalLM(cfg)
+    B, S = 1, 64
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (B, 8)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    cache = model.init_cache(B, S, dtype=jnp.float32)
+    mask = jnp.ones((B, S), jnp.int32)
+
+    def step(params, tok, cache):
+        return model.apply({"params": params}, tok, attention_mask=mask,
+                           cache=cache, cache_index=jnp.int32(8))
+
+    jaxpr = jax.make_jaxpr(step)(params, ids[:, :1], cache)
+    cache_elems = S * cfg.num_key_value_heads * cfg.head_dim
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in ("transpose", "pad"):
+                assert all(int(np.prod(v.aval.shape)) < cache_elems
+                           for v in eqn.invars), \
+                    f"cache-sized {eqn.primitive.name} in xla decode step"
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+                elif isinstance(sub, (list, tuple)):
+                    for e in sub:
+                        if hasattr(e, "jaxpr"):
+                            walk(e.jaxpr)
+
+    walk(jaxpr.jaxpr)

@@ -155,8 +155,9 @@ def main():
 
     Hkv = H // 2
     qd = jnp.asarray(rs.randn(B, H, D), jnp.float32)
-    kc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
-    vc = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.float32)
+    # head-major [B, Hkv, S, D] cache layout (models/layers.py)
+    kc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32)
+    vc = jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32)
     cidx = jnp.int32(S // 2)
     kmask = jnp.asarray(np.arange(S)[None, :] <= S // 2, jnp.int32)
     kmask = jnp.broadcast_to(kmask, (B, S))
@@ -165,7 +166,8 @@ def main():
         pal = jax.jit(lambda a, b, c: decode_attention(
             a, b, c, cidx, key_mask=kmask, force_pallas=True))
         xla = jax.jit(lambda a, b, c: _reference_decode(
-            a, b, c, cidx, kmask, 1.0 / D ** 0.5))
+            a, jnp.swapaxes(b, 1, 2), jnp.swapaxes(c, 1, 2), cidx,
+            kmask, 1.0 / D ** 0.5))
         got, ref = pal(qd, kc, vc), xla(qd, kc, vc)
         return _record("decode_attention", mode, ref, got,
                        _timeit(pal, qd, kc, vc), _timeit(xla, qd, kc, vc),
@@ -183,7 +185,8 @@ def main():
             a, b, c, cidx, key_mask=kmask, k_scale=bs, v_scale=cs,
             force_pallas=True))
         xla = jax.jit(lambda a, b, c, bs, cs: _reference_decode(
-            a, dequantize_kv(b, bs), dequantize_kv(c, cs), cidx, kmask,
+            a, jnp.swapaxes(dequantize_kv(b, bs), 1, 2),
+            jnp.swapaxes(dequantize_kv(c, cs), 1, 2), cidx, kmask,
             1.0 / D ** 0.5))
         got = pal(qd, kq, vq, ks, vs)
         ref = xla(qd, kq, vq, ks, vs)
