@@ -33,6 +33,19 @@ from .layers import (RMSNorm, cross_entropy_loss, init_kv_cache,
 from .llama import LlamaAttention, LlamaConfig
 
 
+def _expert_axis_active() -> bool:
+    """True when the active mesh shards the ``expert`` axis (>1): the
+    gather decode path would pull sharded expert rows cross-device, so it
+    only engages with replicated experts."""
+    from ..parallel.topology import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None:
+        return False
+    return dict(zip(mesh.axis_names,
+                    mesh.devices.shape)).get("expert", 1) > 1
+
+
 def _ep_constraint(t, *spec):
     """Pin a MoE-internal tensor's sharding (axes present in the active mesh
     only; no-op off-mesh). Without these pins the partitioner must invent a
@@ -125,11 +138,8 @@ class MixtralSparseMoeBlock(nn.Module):
         probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
         topk_w, topk_idx = jax.lax.top_k(probs, K)
         topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
-        # dense [B, T, E] combine weights, zero outside the top-k
+        # one-hot routing (also feeds the aux-loss stats below)
         onehot = jax.nn.one_hot(topk_idx, E, dtype=topk_w.dtype)  # [B,T,K,E]
-        combine = jnp.einsum("btk,btke->bte", topk_w, onehot)
-        # combine joins the expert-axis-gathered tokens in the final einsum
-        combine = _ep_constraint(combine, "data", None, None)
 
         # stacked expert SwiGLU: [E, H, I] / [E, I, H], sharded over "expert"
         w1 = self.param("w1", nn.initializers.lecun_normal(), (E, H, I),
@@ -139,18 +149,42 @@ class MixtralSparseMoeBlock(nn.Module):
         w2 = self.param("w2", nn.initializers.lecun_normal(), (E, I, H),
                         jnp.float32)  # down
         dt = x.dtype
-        # EP layout (GShard-style): tokens all-gather over the expert axis at
-        # entry (B drops to data-only sharding), the [B,T,E,·] intermediates
-        # keep E on the expert axis, and the combine contraction over E
-        # reduce-scatters B back onto (data, expert)
-        xg = _ep_constraint(x, "data", None, None)
-        h = nn.silu(jnp.einsum("bth,ehi->btei", xg, w1.astype(dt))) * \
-            jnp.einsum("bth,ehi->btei", xg, w3.astype(dt))
-        h = _ep_constraint(h, "data", None, "expert", None)
-        y = jnp.einsum("btei,eih->bteh", h, w2.astype(dt))
-        y = _ep_constraint(y, "data", None, "expert", None)
-        out = jnp.einsum("bte,bteh->bth", combine.astype(dt), y)
-        out = _ep_constraint(out, ("data", "expert"), None, None)
+        if T == 1 and E > K and not _expert_axis_active():
+            # decode fast path (replicated experts): GATHER only the K
+            # touched experts' weights per token instead of computing all E
+            # — the stacked einsum streams E/K x the weight bytes a decode
+            # step needs (the reference's einsum_sec_sm_ecm / moe_res_matmul
+            # kernels exist for exactly this; tools/bench_moe_decode.py
+            # measures it as gather_speedup_vs_all_e). XLA's gather reads
+            # only the indexed expert rows from HBM.
+            idx = topk_idx[:, 0]                        # [B, K]
+            w1g = jnp.take(w1, idx, axis=0).astype(dt)  # [B, K, H, I]
+            w3g = jnp.take(w3, idx, axis=0).astype(dt)
+            w2g = jnp.take(w2, idx, axis=0).astype(dt)  # [B, K, I, H]
+            xt = x[:, 0]                                # [B, H]
+            hidden = nn.silu(jnp.einsum("bh,bkhi->bki", xt, w1g)) * \
+                jnp.einsum("bh,bkhi->bki", xt, w3g)
+            y = jnp.einsum("bki,bkih->bkh", hidden, w2g)
+            out = jnp.einsum("bk,bkh->bh",
+                             topk_w[:, 0].astype(dt), y)[:, None]
+        else:
+            # dense [B, T, E] combine weights, zero outside the top-k;
+            # the combine joins the expert-axis-gathered tokens in the
+            # final einsum
+            combine = jnp.einsum("btk,btke->bte", topk_w, onehot)
+            combine = _ep_constraint(combine, "data", None, None)
+            # EP layout (GShard-style): tokens all-gather over the expert
+            # axis at entry (B drops to data-only sharding), the [B,T,E,·]
+            # intermediates keep E on the expert axis, and the combine
+            # contraction over E reduce-scatters B back onto (data, expert)
+            xg = _ep_constraint(x, "data", None, None)
+            h = nn.silu(jnp.einsum("bth,ehi->btei", xg, w1.astype(dt))) * \
+                jnp.einsum("bth,ehi->btei", xg, w3.astype(dt))
+            h = _ep_constraint(h, "data", None, "expert", None)
+            y = jnp.einsum("btei,eih->bteh", h, w2.astype(dt))
+            y = _ep_constraint(y, "data", None, "expert", None)
+            out = jnp.einsum("bte,bteh->bth", combine.astype(dt), y)
+            out = _ep_constraint(out, ("data", "expert"), None, None)
 
         # per-layer masked means (HF excludes pad tokens via attention_mask)
         if token_mask is None:

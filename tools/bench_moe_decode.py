@@ -101,9 +101,22 @@ def bench(batch: int, hidden: int, intermediate: int, experts: int, k: int,
     def dense_fn(p, x):
         return dense.apply(p, x)
 
+    import deepspeed_tpu.models.mixtral as mx
+
+    def moe_dense_fn(p, x):
+        # force the all-E stacked-einsum branch (what a no-gather
+        # implementation pays); the shipped decode path is moe_fn
+        orig = mx._expert_axis_active
+        mx._expert_axis_active = lambda: True
+        try:
+            return moe.apply(p, x)[0]
+        finally:
+            mx._expert_axis_active = orig
+
     timings = {}
     hlo = {}
     for name, fn, p in (("moe", moe_fn, moe_params),
+                        ("moe_all_e", moe_dense_fn, moe_params),
                         ("dense", dense_fn, dense_params)):
         jf = jax.jit(fn)
         lowered = jf.lower(p, x)
@@ -125,9 +138,11 @@ def bench(batch: int, hidden: int, intermediate: int, experts: int, k: int,
     # combine mask zeroes the untaken ones), so the ACTUAL traffic is all E
     # experts' weights; a gather-based kernel (what the reference's MoE
     # kernels amount to) would stream only the touched <= min(B*k, E).
-    touched = min(batch * k, experts)
-    moe_bytes_actual = experts * 3 * hidden * intermediate * 2
-    moe_bytes_gather_ideal = touched * 3 * hidden * intermediate * 2
+    # the SHIPPED decode path gathers: HBM streams at most the DISTINCT
+    # touched expert rows (<= min(batch*k, E); duplicate per-token picks
+    # re-read from cache/VMEM, not HBM)
+    moe_bytes_actual = min(batch * k, experts) * 3 * hidden * intermediate * 2
+    moe_all_e_bytes = experts * 3 * hidden * intermediate * 2
     dense_bytes = 3 * hidden * (k * intermediate) * 2
     rec = {
         "metric": "moe_decode_isolation",
@@ -135,14 +150,16 @@ def bench(batch: int, hidden: int, intermediate: int, experts: int, k: int,
         "batch": batch, "hidden": hidden, "intermediate": intermediate,
         "experts": experts, "top_k": k,
         "moe_ms": round(timings["moe"] * 1e3, 3),
+        "moe_all_e_ms": round(timings["moe_all_e"] * 1e3, 3),
         "dense_equiv_ms": round(timings["dense"] * 1e3, 3),
         "moe_overhead_vs_dense": round(timings["moe"] / timings["dense"], 3),
-        # all-E streaming vs the dense baseline's k*I weights
+        # what the shipped gather branch saves vs the all-E einsum
+        "gather_speedup_vs_all_e":
+            round(timings["moe_all_e"] / timings["moe"], 3),
         "expected_weight_traffic_ratio":
             round(moe_bytes_actual / dense_bytes, 3),
-        # what a token-gather kernel could still reclaim (1.0 = nothing)
-        "gather_kernel_opportunity":
-            round(moe_bytes_actual / moe_bytes_gather_ideal, 3),
+        "all_e_weight_traffic_ratio":
+            round(moe_all_e_bytes / moe_bytes_actual, 3),
         "moe_achieved_gbps":
             round(moe_bytes_actual / timings["moe"] / 1e9, 1),
         "dense_achieved_gbps":
