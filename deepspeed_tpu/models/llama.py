@@ -57,6 +57,14 @@ class LlamaConfig:
     #: kernel equivalent; streams the cache per kv head, skips unfilled
     #: blocks)
     decode_attention_impl: str = "xla"
+    #: cached PREFILL via the flash kernel with in-kernel key masking —
+    #: avoids the [B, H, T, S] logits tensor of the XLA cached path (tens
+    #: of GB at serving shapes like batch 64 x prompt 2048). CONTRACT:
+    #: only enable when every multi-token cached apply starts from an
+    #: EMPTY cache (the inference engine's generate does) — the flash
+    #: prefill attends the fresh K/V only, which equals cache attention
+    #: iff nothing preceded it. Chunked prefill must keep this False.
+    prefill_flash_from_empty: bool = False
     # flash kernel tile sizes (VMEM blocks); tuned per chip generation
     flash_block_q: int = 512
     flash_block_k: int = 512
@@ -141,6 +149,25 @@ class LlamaAttention(nn.Module):
                                        k_scale=layer_cache.get("k_scale"),
                                        v_scale=layer_cache.get("v_scale"),
                                        window=cfg.sliding_window)[:, None]
+            elif T > 1 and cfg.prefill_flash_from_empty:
+                # from-empty prefill: attention over the FRESH K/V only
+                # (== cache attention when nothing precedes it; see the
+                # config flag's contract) through the flash kernel with
+                # in-kernel key masking — the XLA cached path would
+                # materialize [B, H, T, S] logits (tens of GB at serving
+                # shapes)
+                from ..ops.pallas.flash_attention import flash_attention
+
+                # key_mask always set: the GQA-native forward (kv-head
+                # index map, no repeat_kv materialization) rides the
+                # masked path
+                local_mask = jnp.ones((B, T), jnp.int32) if mask is None \
+                    else mask[:, :T]
+                out = flash_attention(q, k, v, causal=True,
+                                      key_mask=local_mask,
+                                      block_q=cfg.flash_block_q,
+                                      block_k=cfg.flash_block_k,
+                                      window=cfg.sliding_window)
             else:
                 # head-major XLA math: no cache-sized transpose per step
                 out = cached_attention_xla(q, layer_cache, cache_index,

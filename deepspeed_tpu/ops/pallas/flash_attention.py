@@ -38,9 +38,13 @@ def _ceil_div(a, b):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 sm_scale: float, causal: bool, block_q: int, block_k: int,
-                tq: int, tk: int, window):
+                tq: int, tk: int, window, has_mask: bool = False):
+    if has_mask:
+        kmask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -76,6 +80,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             valid = valid & (rows + (tk - tq) >= cols)
         if window is not None:
             valid = valid & (rows + (tk - tq) - cols < window)
+        if has_mask:  # [B, Tk] key-padding mask (left-padded prompts)
+            valid = valid & (kmask_ref[0] > 0)[None, :]
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:]                       # [bq, 1]
@@ -109,25 +115,40 @@ def _pad_seq(x, block):
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-               window=None):
+               window=None, key_mask=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    Hkv = k.shape[1]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    rep = H // Hkv  # GQA: q head h reads kv head h // rep — no
+    # repeat_kv materialization (the index map does the mapping)
     bq, bk = min(block_q, Tq), min(block_k, Tk)
     # pad to block multiples; kernels mask with the ORIGINAL lengths
     q, k, v = _pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk)
     Tq_p, Tk_p = q.shape[2], k.shape[2]
     grid = (B, H, Tq_p // bq, Tk_p // bk)
 
+    mask_args = []
+    mask_specs = []
+    if key_mask is not None:
+        km = jnp.pad(key_mask.astype(jnp.int32),
+                     ((0, 0), (0, Tk_p - key_mask.shape[1])))
+        mask_args = [km]
+        mask_specs = [pl.BlockSpec((1, bk), lambda b, h, iq, ik: (b, ik))]
+
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, tq=Tq, tk=Tk,
-                          window=window),
+                          window=window, has_mask=key_mask is not None),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-        ],
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+        ] + mask_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq)),
@@ -142,7 +163,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *mask_args)
     return out[:, :, :Tq], lse[:, :, :Tq]  # lse: compact [B,H,Tq] fp32
 
 
@@ -349,8 +370,18 @@ def _vjp_bwd(sm_scale, causal, block_q, block_k, interpret, window, res, g):
 _flash_attention_bhtd.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def _reference_attention(q, k, v, causal, sm_scale, window=None):
+def _reference_attention(q, k, v, causal, sm_scale, window=None,
+                         key_mask=None):
     """[B,T,H,D] einsum reference (used on non-TPU backends)."""
+    if k.shape[2] != q.shape[2]:
+        # GQA (masked fwd-only path accepts un-repeated kv heads): expand
+        # consecutively, matching the kernel's h // rep index map
+        rep = q.shape[2] // k.shape[2]
+        b, t, hk, d = k.shape
+        k = jnp.broadcast_to(k[:, :, :, None], (b, t, hk, rep, d)).reshape(
+            b, t, hk * rep, d)
+        v = jnp.broadcast_to(v[:, :, :, None], (b, t, hk, rep, d)).reshape(
+            b, t, hk * rep, d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     Tq, Tk = q.shape[1], k.shape[1]
     if causal:
@@ -361,6 +392,9 @@ def _reference_attention(q, k, v, causal, sm_scale, window=None):
         j = jnp.arange(Tk)[None, :]
         wmask = (i + (Tk - Tq) - j) < window
         logits = jnp.where(wmask[None, None], logits, NEG_INF)
+    if key_mask is not None:
+        logits = jnp.where((key_mask > 0)[:, None, None, :], logits,
+                           NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -368,11 +402,18 @@ def _reference_attention(q, k, v, causal, sm_scale, window=None):
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None, force_pallas: bool = False,
-                    window: Optional[int] = None):
+                    window: Optional[int] = None, key_mask=None):
     """Flash attention over [B, T, H, D] tensors.
 
     ``interpret=None`` auto-selects: real kernel on TPU, reference math
     elsewhere (interpret mode is available for kernel-parity tests).
+
+    ``key_mask`` ``[B, Tk]`` (1 = real key) masks padded keys in-kernel
+    (left-padded prefill). FORWARD-ONLY: the masked path skips the
+    custom-vjp wrapper (serving prefill never differentiates); taking a
+    gradient through it falls to JAX's default AD over the kernel,
+    which pallas_call does not support — use the unmasked path (drop
+    padding via the loss mask) for training.
     """
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
@@ -380,12 +421,24 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
         on_tpu = jax.default_backend() == "tpu"
         if not on_tpu and not force_pallas:
             return _reference_attention(q, k, v, causal, sm_scale,
-                                        window=window)
+                                        window=window, key_mask=key_mask)
         interpret = not on_tpu
 
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash_attention_bhtd(qt, kt, vt, sm_scale, causal, block_q, block_k,
-                                interpret, window)
+    if key_mask is not None:
+        # fwd-only masked path; GQA rides the kv-head index map (no
+        # repeat_kv materialization)
+        out, _ = _flash_fwd(qt, kt, vt, sm_scale, causal, block_q,
+                            block_k, interpret, window, key_mask)
+    else:
+        if k.shape[2] != q.shape[2]:
+            raise ValueError(
+                "flash_attention training path needs pre-repeated kv "
+                "heads (repeat_kv) — the dK/dV grid accumulates per "
+                "head; GQA-native reads are forward-only (key_mask "
+                "path)")
+        out = _flash_attention_bhtd(qt, kt, vt, sm_scale, causal,
+                                    block_q, block_k, interpret, window)
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -775,3 +775,34 @@ def test_checkpoint_dir_gqa_tied_sharded_safetensors(tmp_path):
     ours = np.asarray(engine.generate(ids, max_new_tokens=6,
                                       do_sample=False))
     np.testing.assert_array_equal(ours, ref)
+
+
+def test_prefill_flash_from_empty_generates_identically():
+    """prefill_flash_from_empty routes cached prefill through the flash
+    kernel (in-kernel key masking): greedy tokens must equal the default
+    XLA cached-prefill path, including left-padded prompts."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(1, cfg.vocab_size, (2, 10))
+    mask = np.ones((2, 10), np.int32)
+    ids[0, :3] = 0
+    mask[0, :3] = 0  # left-padded row
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.asarray(ids))["params"]
+
+    base_eng = ds.init_inference(model, params=params, dtype="fp32",
+                                 max_out_tokens=20)
+    base = np.asarray(base_eng.generate(ids, attention_mask=mask,
+                                        max_new_tokens=6, do_sample=False))
+    fcfg = dataclasses.replace(cfg, prefill_flash_from_empty=True)
+    flash_eng = ds.init_inference(LlamaForCausalLM(fcfg), params=params,
+                                  dtype="fp32", max_out_tokens=20)
+    got = np.asarray(flash_eng.generate(ids, attention_mask=mask,
+                                        max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(got, base)

@@ -59,12 +59,16 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
         if tiny:
             cfg = MixtralConfig.tiny(decode_attention_impl=attn_impl)
         else:
+            # prefill_flash_from_empty: the XLA cached prefill at
+            # (64, 2048) would materialize [B, H, T, S] fp32 logits in the
+            # tens of GB; the flash prefill path never does
             cfg = MixtralConfig(
                 vocab_size=32000, hidden_size=1024, intermediate_size=3584,
                 num_hidden_layers=8, num_attention_heads=16,
                 num_key_value_heads=8, num_local_experts=8,
                 num_experts_per_tok=2, max_position_embeddings=prompt + new,
-                remat=False, decode_attention_impl=attn_impl)
+                remat=False, decode_attention_impl=attn_impl,
+                prefill_flash_from_empty=True)
         model = MixtralForCausalLM(cfg)
     else:
         from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -73,9 +77,11 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
             cfg = LlamaConfig.tiny(remat=False,
                                    decode_attention_impl=attn_impl)
         else:
+            # prefill_flash_from_empty (see mixtral note)
             cfg = LlamaConfig.llama_400m(
                 max_position_embeddings=prompt + new, remat=False,
-                decode_attention_impl=attn_impl)
+                decode_attention_impl=attn_impl,
+                prefill_flash_from_empty=True)
         model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
