@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .layers import (cache_attention_bias, cached_attention_xla,
+                     flash_prefill_from_empty,
                      cross_entropy_loss, dot_product_attention,
                      init_kv_cache, make_causal_mask,
                      shift_labels, update_kv_cache)
@@ -32,6 +33,11 @@ class GPT2Config:
     attn_pdrop: float = 0.0
     embd_pdrop: float = 0.0
     attention_impl: str = "xla"
+    #: cached prefill through the masked flash kernel — only valid when
+    #: every multi-token cached apply starts from an EMPTY cache (the
+    #: inference engine's generate does); see LlamaConfig for the full
+    #: contract
+    prefill_flash_from_empty: bool = False
     scan_layers: bool = True
     remat: bool = False
     #: >0: chunked training loss (models/layers.py); 0 = plain
@@ -62,9 +68,14 @@ class GPT2Attention(nn.Module):
         v = v.reshape(B, T, H, D)
         if layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
-            # head-major XLA math: no cache-sized transpose per step
-            out = cached_attention_xla(q, layer_cache, cache_index,
-                                       key_mask=mask)
+            if T > 1 and cfg.prefill_flash_from_empty:
+                # from-empty prefill via the masked flash kernel (no
+                # [B, H, T, S] logits tensor; see LlamaConfig contract)
+                out = flash_prefill_from_empty(q, k, v, key_mask=mask)
+            else:
+                # head-major XLA math: no cache-sized transpose per step
+                out = cached_attention_xla(q, layer_cache, cache_index,
+                                           key_mask=mask)
         else:
             rng = self.make_rng("dropout") if (cfg.attn_pdrop > 0 and
                                                not deterministic) else None

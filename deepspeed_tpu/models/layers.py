@@ -253,6 +253,24 @@ def read_kv_cache(layer_cache, dtype):
     return jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)
 
 
+def flash_prefill_from_empty(q, k, v, key_mask=None, sm_scale=None,
+                             block_q=512, block_k=512, window=None):
+    """From-empty cached prefill via the masked flash kernel — the ONE
+    dispatch shared by every model family (see
+    ``LlamaConfig.prefill_flash_from_empty`` for the contract). ``q``:
+    ``[B, T, H, D]``; ``k``/``v`` are the FRESH (un-repeated, GQA ok)
+    projections ``[B, T, Hkv, D]``; ``key_mask`` is the full ``[B, S]``
+    cache mask or None (sliced to the prompt span here)."""
+    from ..ops.pallas.flash_attention import flash_attention
+
+    B, T = q.shape[0], q.shape[1]
+    local_mask = jnp.ones((B, T), jnp.int32) if key_mask is None \
+        else key_mask[:, :T]
+    return flash_attention(q, k, v, causal=True, key_mask=local_mask,
+                           sm_scale=sm_scale, block_q=block_q,
+                           block_k=block_k, window=window)
+
+
 def cached_attention_xla(q, layer_cache, cache_index=None, key_mask=None,
                          window=None, scale=None, bias=None):
     """XLA attention over the head-major KV cache with NO cache-sized

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .layers import (RMSNorm, apply_rotary, cache_attention_bias,
-                     cached_attention_xla,
+                     cached_attention_xla, flash_prefill_from_empty,
                      cross_entropy_loss, lm_head_output,
                      dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
@@ -150,24 +150,14 @@ class LlamaAttention(nn.Module):
                                        v_scale=layer_cache.get("v_scale"),
                                        window=cfg.sliding_window)[:, None]
             elif T > 1 and cfg.prefill_flash_from_empty:
-                # from-empty prefill: attention over the FRESH K/V only
-                # (== cache attention when nothing precedes it; see the
-                # config flag's contract) through the flash kernel with
-                # in-kernel key masking — the XLA cached path would
-                # materialize [B, H, T, S] logits (tens of GB at serving
-                # shapes)
-                from ..ops.pallas.flash_attention import flash_attention
-
-                # key_mask always set: the GQA-native forward (kv-head
-                # index map, no repeat_kv materialization) rides the
-                # masked path
-                local_mask = jnp.ones((B, T), jnp.int32) if mask is None \
-                    else mask[:, :T]
-                out = flash_attention(q, k, v, causal=True,
-                                      key_mask=local_mask,
-                                      block_q=cfg.flash_block_q,
-                                      block_k=cfg.flash_block_k,
-                                      window=cfg.sliding_window)
+                # from-empty prefill over the FRESH K/V (== cache attention
+                # when nothing precedes it; see the config flag's contract):
+                # masked flash kernel, GQA-native — the XLA cached path
+                # would materialize [B, H, T, S] logits (tens of GB at
+                # serving shapes)
+                out = flash_prefill_from_empty(
+                    q, k, v, key_mask=mask, block_q=cfg.flash_block_q,
+                    block_k=cfg.flash_block_k, window=cfg.sliding_window)
             else:
                 # head-major XLA math: no cache-sized transpose per step
                 out = cached_attention_xla(q, layer_cache, cache_index,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layers import (cache_attention_bias, cached_attention_xla,
+                     flash_prefill_from_empty,
                      cross_entropy_loss,
                      key_mask_to_bias,
                      dot_product_attention,
@@ -70,6 +71,9 @@ class TransformerConfig:
     #: (ops/pallas/decode_attention.py); the kernel path engages only for
     #: configs it can represent (no alibi, no per-layer local kinds)
     decode_attention_impl: str = "xla"
+    #: cached prefill via the masked flash kernel (same eligibility
+    #: rules; from-empty contract per LlamaConfig)
+    prefill_flash_from_empty: bool = False
     # GPT-Neo: per-layer attention kind, e.g. ("global","local",...) cycled
     # over layers; "local" limits causal attention to a sliding window
     attention_layers: Optional[tuple] = None
@@ -108,6 +112,14 @@ class TransformerConfig:
         attention (kernel dispatch): the decode kernel represents triangular
         + key-padding masking only."""
         return (self.decode_attention_impl == "pallas" and q_len == 1
+                and self.pos_embedding != "alibi"
+                and self.attention_layers is None)
+
+    def prefill_flash_eligible(self, q_len: int) -> bool:
+        """Cached prefill through the masked flash kernel (see
+        LlamaConfig.prefill_flash_from_empty for the from-empty
+        contract); triangular + key-padding masking only."""
+        return (self.prefill_flash_from_empty and q_len > 1
                 and self.pos_embedding != "alibi"
                 and self.attention_layers is None)
 
@@ -214,6 +226,11 @@ class GenericAttention(nn.Module):
                                        k_scale=layer_cache.get("k_scale"),
                                        v_scale=layer_cache.get("v_scale"),
                                        sm_scale=cfg.attention_scale)[:, None]
+            elif cfg.prefill_flash_eligible(T):
+                # from-empty prefill via the masked flash kernel; bias is
+                # the RAW [B, S] key mask on this path (see TransformerModel)
+                out = flash_prefill_from_empty(q, k, v, key_mask=bias,
+                                               sm_scale=cfg.attention_scale)
             else:
                 # head-major XLA math (no cache-sized transpose); bias here
                 # is the model-level composite (cache causality + ALiBi)
@@ -356,7 +373,7 @@ class TransformerModel(nn.Module):
             if not cfg.causal:
                 raise ValueError("KV cache requires a causal decoder config")
             key_mask = attention_mask  # [B, S] over the cache
-            if cfg.pallas_decode_eligible(T):
+            if cfg.pallas_decode_eligible(T) or cfg.prefill_flash_eligible(T):
                 # kernel path: the attention consumes the RAW key mask (the
                 # kernel folds triangular masking itself; None = no padding,
                 # the kernel's own default)

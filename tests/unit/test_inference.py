@@ -806,3 +806,82 @@ def test_prefill_flash_from_empty_generates_identically():
     got = np.asarray(flash_eng.generate(ids, attention_mask=mask,
                                         max_new_tokens=6, do_sample=False))
     np.testing.assert_array_equal(got, base)
+
+
+def test_prefill_flash_gpt2_generates_identically():
+    """GPT-2's prefill_flash_from_empty path: greedy tokens equal the XLA
+    cached-prefill path, including a left-padded prompt."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    rs = np.random.RandomState(4)
+    ids = rs.randint(1, cfg.vocab_size, (2, 9))
+    mask = np.ones((2, 9), np.int32)
+    ids[1, :4] = 0
+    mask[1, :4] = 0
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.asarray(ids))["params"]
+    base = np.asarray(ds.init_inference(model, params=params, dtype="fp32")
+                      .generate(ids, attention_mask=mask, max_new_tokens=5,
+                                do_sample=False))
+    fcfg = dataclasses.replace(cfg, prefill_flash_from_empty=True)
+    got = np.asarray(
+        ds.init_inference(GPT2LMHeadModel(fcfg), params=params, dtype="fp32")
+        .generate(ids, attention_mask=mask, max_new_tokens=5,
+                  do_sample=False))
+    np.testing.assert_array_equal(got, base)
+
+
+@pytest.mark.parametrize("family", ["opt", "gpt_neox"])
+def test_prefill_flash_generic_families(family):
+    """Generic-transformer prefill_flash_from_empty: greedy parity with the
+    XLA cached path (eligible families; left-padded prompt included)."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+
+    hf = _tiny_hf(family)
+    model, params = replace_transformer_layer(hf)
+    rs = np.random.RandomState(6)
+    ids = rs.randint(1, 100, (2, 9))
+    mask = np.ones((2, 9), np.int32)
+    ids[0, :3] = 1
+    mask[0, :3] = 0
+    base = np.asarray(
+        ds.init_inference(model, params=params, dtype="fp32")
+        .generate(ids, attention_mask=mask, max_new_tokens=5,
+                  do_sample=False))
+    fcfg = dataclasses.replace(model.config, prefill_flash_from_empty=True)
+    assert fcfg.prefill_flash_eligible(9)
+    got = np.asarray(
+        ds.init_inference(type(model)(fcfg), params=params, dtype="fp32")
+        .generate(ids, attention_mask=mask, max_new_tokens=5,
+                  do_sample=False))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_prefill_flash_ineligible_alibi_stays_on_xla():
+    """BLOOM (alibi) must not take the flash prefill path even when the
+    flag is set — eligibility is static and output stays correct."""
+    import dataclasses
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.module_inject import replace_transformer_layer
+
+    hf = _tiny_hf("bloom")
+    model, params = replace_transformer_layer(hf)
+    fcfg = dataclasses.replace(model.config, prefill_flash_from_empty=True)
+    assert not fcfg.prefill_flash_eligible(8)
+    ids = np.random.RandomState(8).randint(1, 100, (2, 8))
+    base = np.asarray(
+        ds.init_inference(model, params=params, dtype="fp32")
+        .generate(ids, max_new_tokens=4, do_sample=False))
+    got = np.asarray(
+        ds.init_inference(type(model)(fcfg), params=params, dtype="fp32")
+        .generate(ids, max_new_tokens=4, do_sample=False))
+    np.testing.assert_array_equal(got, base)
