@@ -14,10 +14,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import (cache_attention_bias, cached_attention_xla,
+from .layers import (cached_attention_xla,
                      flash_prefill_from_empty,
                      cross_entropy_loss, dot_product_attention,
-                     init_kv_cache, make_causal_mask,
+                     init_kv_cache,
                      shift_labels, update_kv_cache)
 
 

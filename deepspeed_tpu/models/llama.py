@@ -21,10 +21,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .layers import (RMSNorm, apply_rotary, cache_attention_bias,
+from .layers import (RMSNorm, apply_rotary,
                      cached_attention_xla, flash_prefill_from_empty,
                      cross_entropy_loss, lm_head_output,
-                     dot_product_attention, init_kv_cache, make_causal_mask, repeat_kv,
+                     dot_product_attention, init_kv_cache, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache)
 
