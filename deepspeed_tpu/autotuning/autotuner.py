@@ -211,7 +211,7 @@ class Autotuner:
             pending.append(remaining[int(np.argmax(pred))])
 
     def tune_mfu(self, axes: Optional[Dict] = None,
-                 budget_evals: int = 64, steps: int = 3) -> Dict:
+                 budget_evals: Optional[int] = None, steps: int = 3) -> Dict:
         """Drive the full MFU lever space (remat policy x flash tiles x
         loss_chunk x micro/gas split x Pallas-Adam x attention impl) with
         the memoized, cost-model-guided coordinate descent of
@@ -230,7 +230,8 @@ class Autotuner:
         tuner = MFUTuner(type(self.model), mcfg, self.base_config,
                          self.make_batch, axes=axes, mesh=self.mesh,
                          steps=steps, results_dir=self.cfg.results_dir)
-        return tuner.tune(budget_evals=budget_evals)
+        return tuner.tune(budget_evals=budget_evals if budget_evals
+                          is not None else self.cfg.tuner_num_trials)
 
     def tune(self, steps: Optional[int] = None) -> Dict:
         """Run the space; returns the best full config. Writes per-experiment
@@ -266,9 +267,15 @@ class Autotuner:
 
 def autotune(model, config: Dict, make_batch: Callable[[int], Dict],
              example_batch: Optional[Dict] = None, mesh=None,
-             steps: Optional[int] = None) -> Dict:
+             steps: Optional[int] = None, mfu: bool = False,
+             axes: Optional[Dict] = None) -> Dict:
     """One-call API (the launcher-level ``--autotuning run`` equivalent,
     reference ``runner.py:323``): tune, then return the winning config ready
-    for ``deepspeed_tpu.initialize``."""
-    return Autotuner(model, config, make_batch, example_batch=example_batch,
-                     mesh=mesh).tune(steps=steps)
+    for ``deepspeed_tpu.initialize``. ``mfu=True`` runs the full
+    performance-lever search instead (``Autotuner.tune_mfu``; returns its
+    richer result dict with ``model_config`` + ``config``)."""
+    tuner = Autotuner(model, config, make_batch, example_batch=example_batch,
+                      mesh=mesh)
+    if mfu:
+        return tuner.tune_mfu(axes=axes)
+    return tuner.tune(steps=steps)
