@@ -277,5 +277,9 @@ def autotune(model, config: Dict, make_batch: Callable[[int], Dict],
     tuner = Autotuner(model, config, make_batch, example_batch=example_batch,
                       mesh=mesh)
     if mfu:
+        # forward the caller's measurement budget to the MFU path too (it
+        # was silently dropped before — r5 advisor finding)
+        if steps is not None:
+            return tuner.tune_mfu(axes=axes, steps=steps)
         return tuner.tune_mfu(axes=axes)
     return tuner.tune(steps=steps)

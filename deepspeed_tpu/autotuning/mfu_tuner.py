@@ -205,7 +205,23 @@ class MFUTuner:
                                  or _DEFAULT_SPEC[k] not in self.axes[k]
                                  else _DEFAULT_SPEC[k]) for k in self.axes})
         axis_patience = 2
+        # resume: restart the descent FROM the best persisted measurement —
+        # both the acceptance threshold (best_rec) and the walk position
+        # (cur). Without this a resumed tune starts at the default spec with
+        # a warm cost model, can terminate without revisiting the previously
+        # best spec, and overwrites best_mfu.json with a WORSE best
+        # (tools/attack_mfu.py got this fix in r5; this is the library port).
         best_rec = None
+        for rec in self.results.values():
+            if rec.get("tokens_per_sec") is not None and (
+                    best_rec is None
+                    or rec["tokens_per_sec"] > best_rec["tokens_per_sec"]):
+                best_rec = rec
+        if best_rec is not None and start is None:
+            resumed = {**best_rec["spec"],
+                       "bg": tuple(best_rec["spec"]["bg"])}
+            if set(resumed) == set(self.axes):
+                cur = resumed
         improved = True
         while improved and self.evaluations < budget_evals:
             improved = False

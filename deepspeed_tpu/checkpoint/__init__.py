@@ -6,6 +6,9 @@ state_dict_factory.py``), universal topology-agnostic checkpoints
 from .engine import (AsyncCheckpointEngine, CheckpointEngine,
                      OrbaxCheckpointEngine, load_pytree, load_train_state,
                      save_pytree, save_train_state)
+from .manifest import (CheckpointCorruptionError, fsck, last_verified_tag,
+                       prune_checkpoints, resolve_load_tag, verify_checkpoint,
+                       write_manifest)
 from .reshape import (ShardedCheckpointLoader, get_sd_loader, infer_rule,
                       merge_qkv, merge_state_dicts, reshape_tp, split_qkv,
                       split_state_dict)

@@ -17,7 +17,12 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils.fault_injection import (maybe_corrupt_file, maybe_crash,
+                                     maybe_fail, maybe_truncate_file,
+                                     retry_with_backoff)
 from ..utils.logging import log_dist
+from .manifest import (atomic_write_json, atomic_write_text, resolve_load_tag,
+                       write_manifest)
 
 LATEST_FILE = "latest"  # reference writes the same tag file
 
@@ -143,25 +148,62 @@ def load_pytree_numpy(path: str) -> Any:
 
 
 def save_train_state(save_dir: str, tag: str, state, client_state: Dict,
-                     save_latest: bool = True, use_async: bool = False) -> None:
+                     save_latest: bool = True, use_async: bool = False,
+                     save_retries: int = 3, retry_backoff_s: float = 0.5,
+                     manifest_checksums: bool = True) -> None:
+    """Verified atomic save protocol (see ``checkpoint/manifest.py``):
+    data commit → client_state (atomic) → manifest (atomic, LAST) →
+    ``latest`` (atomic). A death at any point leaves either the previous
+    verified save authoritative or this one fully verified — never a
+    half-save a resume could trust. Orbax I/O is retried with bounded
+    exponential backoff (transient shared-FS errors must not look like a
+    dead worker to the elastic agent)."""
     os.makedirs(save_dir, exist_ok=True)
     path = os.path.join(os.path.abspath(save_dir), tag)
+    step = client_state.get("global_steps") if client_state else None
     engine = AsyncCheckpointEngine() if use_async else OrbaxCheckpointEngine()
     engine.create(tag)
-    engine.save(state, path)
-    with open(os.path.join(save_dir, f"{tag}.client_state.json"), "w") as f:
-        json.dump(client_state, f)
+    maybe_crash("crash_during_save", step=step, tag=tag, phase="begin")
+
+    def _write():
+        maybe_fail("flaky_save", step=step, tag=tag)
+        engine.save(state, path)
+
+    retry_with_backoff(_write, retries=save_retries,
+                       base_delay=retry_backoff_s,
+                       what=f"checkpoint save {tag}",
+                       exceptions=(OSError, ValueError))
+    atomic_write_json(os.path.join(save_dir, f"{tag}.client_state.json"),
+                      client_state)
+    engine.commit(tag)  # async flush must land before the manifest hashes it
+    # injected death AFTER the data commit but BEFORE the manifest/latest:
+    # the classic partial save this protocol exists to survive
+    maybe_crash("crash_during_save", step=step, tag=tag, phase="commit")
+    if jax.process_index() != 0:
+        # one writer for the manifest + latest: orbax's save/commit path
+        # barriers across processes before finalizing, so by the time rank 0
+        # proceeds past commit EVERY rank's chunks are durable — and a
+        # manifest written by a faster rank mid-save could otherwise
+        # inventory (and 'verify') an incomplete multi-process save
+        return
+    mpath = write_manifest(save_dir, tag, step=step,
+                           checksums=manifest_checksums)
+    maybe_corrupt_file("corrupt_manifest", mpath, step=step, tag=tag)
     if save_latest:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
-    engine.commit(tag)
+        latest_path = os.path.join(save_dir, LATEST_FILE)
+        atomic_write_text(latest_path, tag)
+        maybe_truncate_file("truncate_latest", latest_path, step=step, tag=tag)
 
 
 def load_train_state(load_dir: str, tag: Optional[str], template_state, state_shardings,
-                     load_optimizer_states: bool = True) -> Tuple[Any, Dict]:
-    if tag is None:
-        latest_path = os.path.join(load_dir, LATEST_FILE)
-        with open(latest_path) as f:
+                     load_optimizer_states: bool = True,
+                     verify: bool = True) -> Tuple[Any, Dict]:
+    if verify:
+        # untrusted-latest path: verify the manifest, walk back to the
+        # newest verified save on a missing/corrupt/partial one
+        tag = resolve_load_tag(load_dir, tag)
+    elif tag is None:
+        with open(os.path.join(load_dir, LATEST_FILE)) as f:
             tag = f.read().strip()
     path = os.path.join(os.path.abspath(load_dir), tag)
 

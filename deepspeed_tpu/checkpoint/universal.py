@@ -185,10 +185,12 @@ def convert_checkpoint(ckpt_dir: str, out_dir: str,
     """Offline: engine checkpoint directory → universal directory (the
     ``ds_to_universal`` CLI body; no engine or device mesh required)."""
     from .engine import load_pytree_numpy
+    from .manifest import resolve_load_tag
 
-    if tag is None:
-        with open(os.path.join(ckpt_dir, "latest")) as f:
-            tag = f.read().strip()
+    # untrusted `latest`: verify the manifest and fall back to the newest
+    # verified save rather than converting a torn/partial checkpoint into
+    # the thing every future incarnation resumes from
+    tag = resolve_load_tag(ckpt_dir, tag)
     raw = load_pytree_numpy(os.path.join(ckpt_dir, tag))
     client_state = {}
     cs_path = os.path.join(ckpt_dir, f"{tag}.client_state.json")

@@ -215,13 +215,13 @@ def send_recv_next(tensor, group: AxisName = "pipe"):
     Reference p2p: ``deepspeed/runtime/pipe/p2p.py:40`` send/recv between
     adjacent stages — under SPMD both sides are one ppermute.
     """
-    n = lax.axis_size(group)
+    n = axis_size(group)
     return permute(tensor, [(i, (i + 1) % n) for i in range(n)], group)
 
 
 def send_recv_prev(tensor, group: AxisName = "pipe"):
     """Rotate shards dst = src-1 (ring); pipeline gradient send."""
-    n = lax.axis_size(group)
+    n = axis_size(group)
     return permute(tensor, [(i, (i - 1) % n) for i in range(n)], group)
 
 
@@ -231,7 +231,9 @@ def axis_rank(group: AxisName = "data"):
 
 
 def axis_size(group: AxisName = "data") -> int:
-    return lax.axis_size(group)
+    from ..utils.jax_compat import axis_size as _axis_size
+
+    return _axis_size(group)
 
 
 def barrier(group: AxisName = "data"):
@@ -276,8 +278,28 @@ def init_distributed(dist_backend: str = "xla", coordinator_address: Optional[st
     if coordinator_address is None:
         coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
     if coordinator_address is not None or (num_processes and num_processes > 1):
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes, process_id=process_id)
+        from ..utils.fault_injection import maybe_fail, retry_with_backoff
+
+        def _connect():
+            maybe_fail("flaky_init", rank=process_id)
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+
+        # the coordinator may still be binding its port while workers of a
+        # fresh (or just-restarted) incarnation race to connect — bounded
+        # backoff instead of an instant crash-loop through the elastic
+        # agent. Only transient classes retry (connect/RPC errors); plain
+        # RuntimeError ("already initialized", bad arguments) fails fast.
+        _xla_err = getattr(getattr(jax, "errors", None), "JaxRuntimeError",
+                           None)
+        retry_with_backoff(
+            _connect,
+            retries=int(os.environ.get("DS_TPU_INIT_RETRIES", "3")),
+            base_delay=float(os.environ.get("DS_TPU_INIT_BACKOFF", "2.0")),
+            what="init_distributed coordinator connect",
+            exceptions=tuple(c for c in (OSError, ConnectionError, _xla_err)
+                             if c is not None))
         if verbose:
             log_dist(f"jax.distributed initialized: process {jax.process_index()} of "
                      f"{jax.process_count()}", ranks=[0])

@@ -32,11 +32,15 @@ import time
 from typing import Dict, List, Optional
 
 from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
+from .heartbeat import HeartbeatMonitor
 
 # env contract with the engine (runtime/engine.py reads these)
 CHECKPOINT_DIR_ENV = "DS_ELASTIC_CHECKPOINT_DIR"
 RESTART_COUNT_ENV = "DS_ELASTIC_RESTART_COUNT"
 UNIVERSAL_SUBDIR = "elastic_universal"
+
+#: synthetic exit code for a worker tree the heartbeat watchdog hard-killed
+WATCHDOG_RC = 86
 
 
 def latest_universal_dir(checkpoint_dir: str) -> Optional[str]:
@@ -74,7 +78,8 @@ class ElasticAgent:
                  convert_timeout_s: float = 600.0,
                  nnodes: int = 1, node_rank: int = 0,
                  coordinator_host: str = "127.0.0.1",
-                 barrier_timeout_s: float = 180.0):
+                 barrier_timeout_s: float = 180.0,
+                 heartbeat_timeout_s: float = 0.0):
         self.script = script
         self.script_args = list(script_args)
         self.nproc = nproc
@@ -90,6 +95,8 @@ class ElasticAgent:
         self.node_rank = int(node_rank)
         self.coordinator_host = coordinator_host
         self.barrier_timeout_s = barrier_timeout_s
+        #: heartbeat staleness threshold; <= 0 disables the hang watchdog
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
 
     # -- world-size policy -------------------------------------------------
 
@@ -116,6 +123,19 @@ class ElasticAgent:
     # -- incarnation -------------------------------------------------------
 
     def _spawn(self, nproc: int, restart_count: int) -> subprocess.Popen:
+        if self.nnodes == 1:
+            # single-node: this agent owns every rank, so clear the previous
+            # incarnation's heartbeat files — shrunk worlds otherwise leave
+            # orphan rank files that read as ever-growing staleness in
+            # ds_report/ds_elastic health output (the watchdog itself
+            # already ignores pre-incarnation beats). Multinode agents must
+            # not do this: peers' ranks share the directory.
+            import shutil
+
+            from .heartbeat import heartbeat_dir
+
+            shutil.rmtree(heartbeat_dir(self.checkpoint_dir),
+                          ignore_errors=True)
         cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
                f"--nproc_per_node={nproc}", f"--nnodes={self.nnodes}",
                f"--node_rank={self.node_rank}",
@@ -144,9 +164,27 @@ class ElasticAgent:
         except subprocess.TimeoutExpired:  # pragma: no cover
             pass
 
+    def _resolve_resume_tag(self) -> Optional[str]:
+        """The newest save whose manifest verifies (the untrusted ``latest``
+        pointer is only a hint); None when the dir has no loadable save at
+        all — resume from scratch, loudly, rather than crash-loop on a
+        corrupt checkpoint."""
+        from ..checkpoint.manifest import (CheckpointCorruptionError,
+                                           list_tags, resolve_load_tag)
+
+        if not os.path.exists(os.path.join(self.checkpoint_dir, "latest")) \
+                and not list_tags(self.checkpoint_dir):
+            return None  # genuinely no save yet
+        try:
+            return resolve_load_tag(self.checkpoint_dir, None)
+        except CheckpointCorruptionError as e:
+            print(f"elastic-agent: NO VERIFIED CHECKPOINT to resume from "
+                  f"({e}); restarting from scratch", file=sys.stderr)
+            return None
+
     def _convert_latest(self) -> Optional[str]:
-        """Latest engine checkpoint → universal dir; None if no save yet or
-        the conversion failed.
+        """Newest *verified* engine checkpoint → universal dir; None if no
+        loadable save or the conversion failed.
 
         Runs in a CPU-platform subprocess: the conversion is host-side numpy
         work, and the agent must never block on accelerator init (the whole
@@ -155,16 +193,11 @@ class ElasticAgent:
         never leave a mixed-step checkpoint behind."""
         import shutil
 
-        latest = os.path.join(self.checkpoint_dir, "latest")
-        if not os.path.exists(latest):
+        tag = self._resolve_resume_tag()
+        if tag is None:
             return None
-        try:
-            with open(latest) as f:
-                tag = f.read().strip()
-        except OSError:
-            tag = ""
-        if tag and os.path.exists(os.path.join(self.checkpoint_dir,
-                                               f"{tag}.infinity.npz")):
+        if os.path.exists(os.path.join(self.checkpoint_dir,
+                                       f"{tag}.infinity.npz")):
             # ZeRO-Infinity host checkpoints are already topology-agnostic
             # (fp32 masters npz, no mesh); the respawned workers auto-resume
             # them directly — running the orbax converter here would just
@@ -179,7 +212,8 @@ class ElasticAgent:
         src = ("import jax\n"
                "jax.config.update('jax_platforms', 'cpu')\n"
                "from deepspeed_tpu.checkpoint.universal import convert_checkpoint\n"
-               f"convert_checkpoint({self.checkpoint_dir!r}, {tmp!r})\n")
+               f"convert_checkpoint({self.checkpoint_dir!r}, {tmp!r}, "
+               f"tag={tag!r})\n")
         try:
             r = subprocess.run([sys.executable, "-c", src],
                                capture_output=True, text=True,
@@ -207,17 +241,21 @@ class ElasticAgent:
         fresh conversion or start from the engine state they can reach."""
         import shutil
 
+        from ..checkpoint.manifest import tag_step
+
         uni = latest_universal_dir(self.checkpoint_dir)
-        latest = os.path.join(self.checkpoint_dir, "latest")
-        if uni is None or not os.path.exists(latest):
+        if uni is None:
+            return
+        tag = self._resolve_resume_tag()
+        if tag is None:
             return
         try:
             with open(os.path.join(uni, "universal_meta.json")) as f:
                 uni_step = int(json.load(f).get("step") or 0)
-            with open(latest) as f:
-                tag = f.read().strip()
-            latest_step = int(tag.rsplit("global_step", 1)[-1])
+            latest_step = tag_step(self.checkpoint_dir, tag)
         except (ValueError, OSError):
+            return
+        if latest_step is None:
             return
         if uni_step < latest_step:
             print(f"elastic-agent: universal checkpoint (step {uni_step}) is "
@@ -293,15 +331,7 @@ class ElasticAgent:
             print(f"{tag}: incarnation {epoch - base}: {nproc} workers "
                   f"(nnodes={self.nnodes})", file=sys.stderr, flush=True)
             proc = self._spawn(nproc, epoch - base)
-            rc = None
-            while True:
-                rc = proc.poll()
-                if rc is not None:
-                    break
-                if self._read_epoch() > epoch:
-                    rc = -1  # a PEER lost workers; ours are wedged — kill
-                    break
-                time.sleep(1.0)
+            rc = self._babysit(proc, peer_epoch=epoch)
             if rc == 0:
                 return 0
             self._reap(proc)
@@ -344,6 +374,29 @@ class ElasticAgent:
 
     # -- the health loop ---------------------------------------------------
 
+    def _babysit(self, proc: subprocess.Popen,
+                 peer_epoch: Optional[int] = None) -> int:
+        """Poll one incarnation's worker tree until it exits, a peer bumps
+        the shared epoch (multinode), or the heartbeat watchdog declares it
+        wedged. Returns the exit code (``WATCHDOG_RC`` for a hang-kill, -1
+        for a peer-driven kill)."""
+        monitor = HeartbeatMonitor(self.checkpoint_dir,
+                                   self.heartbeat_timeout_s)
+        monitor.start()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if peer_epoch is not None and self._read_epoch() > peer_epoch:
+                return -1  # a PEER lost workers; ours are wedged — kill
+            wedged = monitor.check()
+            if wedged:
+                print(f"elastic-agent[{self.node_rank}]: WATCHDOG: {wedged}; "
+                      f"hard-killing the worker tree",
+                      file=sys.stderr, flush=True)
+                return WATCHDOG_RC
+            time.sleep(1.0)
+
     def run(self) -> int:
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         if self.nnodes > 1:
@@ -363,7 +416,7 @@ class ElasticAgent:
             print(f"elastic-agent: incarnation {restarts}: {nproc} workers",
                   file=sys.stderr, flush=True)
             proc = self._spawn(nproc, restarts)
-            rc = proc.wait()
+            rc = self._babysit(proc)
             if rc == 0:
                 return 0
             self._reap(proc)  # the rest of the incarnation's tree, hard
@@ -413,6 +466,11 @@ def main(argv=None) -> int:
                     help="seconds to wait for peer agents at a restart "
                          "barrier (the ready barrier additionally allows "
                          "for the checkpoint conversion)")
+    ap.add_argument("--heartbeat_timeout", type=float, default=300.0,
+                    help="hang watchdog: kill + restart the worker tree when "
+                         "a rank's heartbeat goes this stale (seconds; must "
+                         "exceed the slowest train step AND the initial "
+                         "compile; 0 disables)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs="*")
     args = ap.parse_args(argv)
@@ -427,7 +485,8 @@ def main(argv=None) -> int:
         max_restarts=args.max_restarts, min_procs=args.min_procs,
         nnodes=args.nnodes, node_rank=args.node_rank,
         coordinator_host=args.coordinator_host,
-        barrier_timeout_s=args.barrier_timeout)
+        barrier_timeout_s=args.barrier_timeout,
+        heartbeat_timeout_s=args.heartbeat_timeout)
     return agent.run()
 
 

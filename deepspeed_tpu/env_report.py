@@ -64,12 +64,72 @@ def env_info():
         pass
 
 
-def main():
+def checkpoint_report(ckpt_dir: str) -> int:
+    """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
+    every save's manifest in a checkpoint dir, print the last-good tag.
+    Exit code 0 iff the ``latest`` pointer resolves to a verified save."""
+    from deepspeed_tpu.checkpoint.manifest import fsck
+
+    report = fsck(ckpt_dir)
+    print("-" * 60)
+    print(f"checkpoint fsck: {ckpt_dir}")
+    print("-" * 60)
+    if not report["saves"]:
+        print("no saves found")
+        return 1
+    badge = {"verified": GREEN_OK, "legacy": "[LEGACY]", "bad": RED_NO}
+    for rec in report["saves"]:
+        print(f"{rec['tag']:<32}{badge.get(rec['status'], rec['status']):<20}"
+              f"{rec['detail']}")
+    print("-" * 60)
+    print(f"latest tag: {report['latest']} "
+          f"({report['latest_status'] or 'missing'})")
+    print(f"last verified (resume target on fallback): {report['last_good']}")
+    healthy = report["latest_status"] in ("verified", "legacy")
+    heartbeat_report(ckpt_dir)
+    return 0 if healthy else 1
+
+
+def heartbeat_report(ckpt_dir: str) -> None:
+    import time
+
+    from deepspeed_tpu.elasticity.heartbeat import read_heartbeats
+
+    beats = read_heartbeats(ckpt_dir)
+    if not beats:
+        return
+    now = time.time()
+    print("-" * 60)
+    for rank, rec in sorted(beats.items()):
+        age = now - max(rec.get("mtime", 0.0), rec.get("time", 0.0))
+        note = ""
+        if age > 600:
+            # not necessarily a wedge: shrunk/finished incarnations leave
+            # their last beats behind (the watchdog itself only judges
+            # beats from the live incarnation)
+            note = "  [stale — rank inactive or from a previous incarnation]"
+        print(f"heartbeat rank {rank}: step {rec.get('step')}, "
+              f"{age:.0f}s ago (pid {rec.get('pid')}){note}")
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description="DeepSpeed-TPU environment / "
+                                             "checkpoint health report")
+    ap.add_argument("--verify-checkpoint", metavar="DIR", default=None,
+                    help="fsck mode: validate every checkpoint manifest in "
+                         "DIR and print the last-good tag (exit 1 when the "
+                         "latest save fails verification)")
+    args = ap.parse_args(argv)
+    if args.verify_checkpoint:
+        return checkpoint_report(args.verify_checkpoint)
     print("=" * 60)
     print("DeepSpeed-TPU environment report (ds_report)")
     print("=" * 60)
     env_info()
     op_report()
+    return 0
 
 
 def cli_main():
@@ -77,4 +137,4 @@ def cli_main():
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

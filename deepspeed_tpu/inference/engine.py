@@ -65,7 +65,13 @@ class InferenceEngine:
                 (config.mp_size > 1 and axes.get("model", 1) != config.mp_size) or \
                 (ep_size > 1 and axes.get("expert", 1) != ep_size):
             mesh = build_mesh(model=config.mp_size, expert=ep_size)
-            set_mesh(mesh)
+        # ALWAYS register the engine's mesh globally: model-internal layout
+        # checks (e.g. mixtral._expert_axis_active gating the T==1 gather
+        # fast path) consult get_mesh(), and an explicitly-passed
+        # expert-sharded mesh previously skipped set_mesh — engaging the
+        # replicated-experts decode path on sharded weights (per-step
+        # cross-device weight gathers; r5 advisor finding).
+        set_mesh(mesh)
         self.mesh = mesh
         self.mp_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
         self.ep_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("expert", 1)

@@ -20,10 +20,9 @@ def main():
         sys.exit(2)
     cpu_devices = os.environ.get("DS_TPU_CPU_DEVICES")
     if cpu_devices:
-        import jax
+        from ..utils.jax_compat import force_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(cpu_devices))
+        force_cpu_devices(int(cpu_devices))
     script, args = sys.argv[1], sys.argv[2:]
     sys.argv = [script] + args
     runpy.run_path(script, run_name="__main__")

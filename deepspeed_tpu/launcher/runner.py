@@ -180,6 +180,10 @@ def parse_args(argv=None):
                         "compatible-world-size set on resize")
     p.add_argument("--max_elastic_restarts", type=int, default=3)
     p.add_argument("--min_elastic_procs", type=int, default=1)
+    p.add_argument("--elastic_heartbeat_timeout", type=float, default=300.0,
+                   help="hang watchdog: restart the worker tree when a "
+                        "rank's heartbeat goes this stale (seconds; 0 "
+                        "disables)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -208,7 +212,8 @@ def main(argv=None) -> int:
             coordinator_port=args.coordinator_port,
             cpu_devices_per_proc=args.cpu_devices_per_proc,
             max_restarts=args.max_elastic_restarts,
-            min_procs=args.min_elastic_procs)
+            min_procs=args.min_elastic_procs,
+            heartbeat_timeout_s=args.elastic_heartbeat_timeout)
         return agent.run()
 
     if args.hostfile is None:

@@ -413,6 +413,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         # between incarnations (reference DSElasticAgent restart semantics)
         self._elastic_ckpt_dir = os.environ.get("DS_ELASTIC_CHECKPOINT_DIR")
         if self._elastic_ckpt_dir:
+            # NOTE: no heartbeat here by design — the watchdog only judges a
+            # rank from its SECOND beat (heartbeat.py), so the restore and
+            # first-compile phases are unprotected rather than falsely
+            # killed when they outlast the heartbeat timeout
             from ..elasticity.elastic_agent import latest_universal_dir
 
             uni = latest_universal_dir(self._elastic_ckpt_dir)
@@ -793,6 +797,21 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
             batch = {k: cut(k, np.asarray(v)) for k, v in batch.items()}
 
+        # fault-tolerance hooks: heartbeat for the agent's hang watchdog
+        # (written BEFORE the step so staleness ~ time wedged in the step),
+        # plus the deterministic DS_FAULT injection points
+        ft = self._config.fault_tolerance
+        if self._elastic_ckpt_dir and ft.enabled and ft.heartbeat_interval \
+                and self.global_steps % ft.heartbeat_interval == 0:
+            from ..elasticity.heartbeat import write_heartbeat
+
+            write_heartbeat(self._elastic_ckpt_dir, jax.process_index(),
+                            self.global_steps)
+        from ..utils.fault_injection import maybe_crash, maybe_stall
+
+        maybe_crash("crash", step=self.global_steps, rank=jax.process_index())
+        maybe_stall("stall", step=self.global_steps, rank=jax.process_index())
+
         if self.wall_clock_breakdown:
             self.timers("train_batch").start()
         self.tput_timer.start()
@@ -831,7 +850,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         if self._elastic_ckpt_dir and self.global_steps % \
                 max(1, self._config.elasticity.save_interval) == 0:
             self.save_checkpoint(self._elastic_ckpt_dir)
-            self._prune_elastic_checkpoints(keep=2)
+            self._prune_elastic_checkpoints(keep=max(1, ft.keep_checkpoints))
         self.tput_timer.stop()
         if self.wall_clock_breakdown:
             self.timers("train_batch").stop()
@@ -846,26 +865,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
 
     def _prune_elastic_checkpoints(self, keep: int) -> None:
         """The engine owns the elastic auto-save cadence, so it must also own
-        the disk: keep the newest ``keep`` global_step* snapshots (only
-        ``latest`` is ever converted/resumed by the agent)."""
+        the disk: keep the newest ``keep`` snapshots — but never delete the
+        newest *verified* save, the job's only guaranteed way back when a
+        newer save turns out partial/corrupt (checkpoint/manifest.py)."""
         if jax.process_index() != 0:
             return
-        import re
-        import shutil
+        from ..checkpoint.manifest import prune_checkpoints
 
-        d = self._elastic_ckpt_dir
-        steps = []
-        for name in os.listdir(d):
-            m = re.fullmatch(r"global_step(\d+)", name)
-            if m and os.path.isdir(os.path.join(d, name)):
-                steps.append(int(m.group(1)))
-        for s in sorted(steps)[:-keep]:
-            shutil.rmtree(os.path.join(d, f"global_step{s}"),
-                          ignore_errors=True)
-            try:
-                os.remove(os.path.join(d, f"global_step{s}.client_state.json"))
-            except OSError:
-                pass
+        prune_checkpoints(self._elastic_ckpt_dir, keep=keep)
 
     def _print_flops_profile(self, shaped_batch, rng, step_time_s):
         """Flops-profiler hook (reference ``engine.py:1615,1634``: start at
@@ -1029,9 +1036,12 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         client_state = dict(client_state or {})
         client_state.update(global_steps=self.global_steps,
                             skipped_steps=self.get_skipped_steps())
-        save_train_state(save_dir, tag, self.state, client_state, save_latest=save_latest)
+        ft = self._config.fault_tolerance
         if self._offload:
-            # host-side fp32 masters + moments live outside TrainState
+            # host-side fp32 masters + moments live outside TrainState;
+            # written BEFORE the manifest so the save's integrity check
+            # covers them too
+            os.makedirs(save_dir, exist_ok=True)
             sd = self._host_opt.state_dict()
             np.savez(os.path.join(save_dir, f"{tag}.host_optimizer.npz"),
                      step=sd["step"],
@@ -1039,6 +1049,11 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                      **{f"moment_{mi}_{li}": buf
                         for mi, bank in enumerate(sd["moments"])
                         for li, buf in enumerate(bank)})
+        save_train_state(save_dir, tag, self.state, client_state,
+                         save_latest=save_latest,
+                         save_retries=ft.save_retries if ft.enabled else 0,
+                         retry_backoff_s=ft.save_retry_backoff,
+                         manifest_checksums=ft.manifest_checksums)
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -1084,10 +1099,17 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 self._sparse_skip_mark = self.get_skipped_steps()
             return load_dir, client_state
         from ..checkpoint.engine import load_train_state
+        from ..checkpoint.manifest import resolve_load_tag
 
+        ft = self._config.fault_tolerance
+        if ft.enabled and ft.verify_on_load:
+            # resolve+verify once up front (fallback walk on corrupt/partial
+            # saves) so the offload sidecar below agrees with the restored
+            # tag; load_train_state then takes the concrete tag as-is
+            tag = resolve_load_tag(load_dir, tag)
         state, client_state = load_train_state(
             load_dir, tag, self.state, self.state_shardings,
-            load_optimizer_states=load_optimizer_states)
+            load_optimizer_states=load_optimizer_states, verify=False)
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         if self._offload:

@@ -274,11 +274,13 @@ class ZeroInfinityEngine:
         self._elastic_ckpt_dir = _os.environ.get(
             "DS_ELASTIC_CHECKPOINT_DIR")
         if self._elastic_ckpt_dir:
-            latest = _os.path.join(self._elastic_ckpt_dir, "latest")
-            tag = ""
-            if _os.path.exists(latest):
-                with open(latest) as _f:
-                    tag = _f.read().strip()
+            from ...checkpoint.manifest import (CheckpointCorruptionError,
+                                                resolve_load_tag)
+
+            try:
+                tag = resolve_load_tag(self._elastic_ckpt_dir, None)
+            except (CheckpointCorruptionError, OSError):
+                tag = ""
             # resume only an INFINITY npz: 'latest' alone may point at a
             # plain-engine directory checkpoint from a previous job
             if tag and _os.path.exists(_os.path.join(
@@ -680,34 +682,30 @@ class ZeroInfinityEngine:
                 self.global_steps % max(
                     1, self._config.elasticity.save_interval) == 0:
             self.save_checkpoint(self._elastic_ckpt_dir)
-            self._prune_elastic_checkpoints(keep=2)
+            self._prune_elastic_checkpoints(keep=max(
+                1, self._config.fault_tolerance.keep_checkpoints))
         self._last_step_s = time.perf_counter() - t0
         return loss
 
     def _prune_elastic_checkpoints(self, keep: int) -> None:
         """The masters make each save O(model fp32) on disk — keep only the
-        newest ``keep`` snapshots in the agent dir."""
+        newest ``keep`` snapshots in the agent dir (manifest-aware: sidecar
+        manifests go with their npz, and the newest VERIFIED save is never
+        deleted — checkpoint/manifest.py)."""
         import os
-        import re
+
+        from ...checkpoint.manifest import prune_checkpoints
 
         d = self._elastic_ckpt_dir
-        steps = []
         for name in os.listdir(d):
-            m = re.fullmatch(r"global_step(\d+)\.infinity\.npz", name)
-            if m:
-                steps.append(int(m.group(1)))
-            elif name.endswith(".infinity.npz.tmp"):
+            if name.endswith(".infinity.npz.tmp"):
                 # a SIGKILLed save leaves an O(model-fp32) torn tmp behind;
                 # any tmp still present at the NEXT save is dead weight
                 try:
                     os.remove(os.path.join(d, name))
                 except OSError:
                     pass
-        for s in sorted(steps)[:-keep]:
-            try:
-                os.remove(os.path.join(d, f"global_step{s}.infinity.npz"))
-            except OSError:
-                pass
+        prune_checkpoints(d, keep=keep)
 
     # -- checkpointing ---------------------------------------------------
     # Host-side state (bf16 layer store + fp32 masters/moments) saved as
@@ -736,11 +734,13 @@ class ZeroInfinityEngine:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, path)
+        # same verified-save protocol as the main engine: manifest lands
+        # atomically BEFORE latest, so resume never trusts a torn npz
+        from ...checkpoint.manifest import atomic_write_text, write_manifest
+
+        write_manifest(save_dir, tag, step=self.global_steps)
         if save_latest:
-            ltmp = os.path.join(save_dir, "latest.tmp")
-            with open(ltmp, "w") as f:
-                f.write(tag)
-            os.replace(ltmp, os.path.join(save_dir, "latest"))
+            atomic_write_text(os.path.join(save_dir, "latest"), tag)
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -749,9 +749,28 @@ class ZeroInfinityEngine:
 
         import ml_dtypes
 
-        if tag is None:
-            with open(os.path.join(load_dir, "latest")) as f:
-                tag = f.read().strip()
+        from ...checkpoint.manifest import (CheckpointCorruptionError,
+                                            list_tags, resolve_load_tag,
+                                            verify_checkpoint)
+
+        # verified resume: corrupt/partial saves fall back to the newest
+        # save whose manifest verifies (pre-manifest saves load as legacy).
+        # The fallback walk is restricted to INFINITY saves — a mixed dir's
+        # newest verified tag may be a plain-engine orbax directory this
+        # engine cannot np.load.
+        def _has_npz(t):
+            return os.path.exists(os.path.join(load_dir, f"{t}.infinity.npz"))
+
+        tag = resolve_load_tag(load_dir, tag)
+        if not _has_npz(tag):
+            candidates = [t for t in list_tags(load_dir) if _has_npz(t) and
+                          verify_checkpoint(load_dir, t)[0] in ("verified",
+                                                                "legacy")]
+            if not candidates:
+                raise CheckpointCorruptionError(
+                    f"no loadable ZeRO-Infinity checkpoint in {load_dir} "
+                    f"(newest verified save {tag!r} is not an infinity npz)")
+            tag = candidates[0]
         z = np.load(os.path.join(load_dir, f"{tag}.infinity.npz"))
         n = len(self._host_opt.master)
         nbanks = len(self._host_opt._moments)

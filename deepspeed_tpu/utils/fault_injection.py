@@ -1,0 +1,214 @@
+"""Deterministic fault injection for the fault-tolerance layer.
+
+Faults are requested through the ``DS_FAULT`` environment variable so a test
+(or a chaos drill on a real pod) can arm them without touching the training
+script. Grammar — comma-separated specs, each ``name[:key=value]*``::
+
+    DS_FAULT=crash_during_save:step=3        # die after the data commit of
+                                             # the step-3 save, before its
+                                             # manifest/latest are written
+    DS_FAULT=stall:rank=1                    # rank 1 wedges in the step loop
+                                             # (the hang the watchdog kills)
+    DS_FAULT=corrupt_manifest                # scribble over the manifest
+                                             # right after it is written
+    DS_FAULT=truncate_latest                 # tear the `latest` tag file
+    DS_FAULT=flaky_save:fails=2              # first 2 save attempts raise
+                                             # OSError (exercises the
+                                             # retry-with-backoff path)
+    DS_FAULT=flaky_init:fails=1              # coordinator connect fails once
+
+Recognized match keys: ``step`` / ``rank`` / ``tag`` (spec fires only when
+the injection point reports a matching value), ``fails`` (bounded faults:
+fire at most N times, then the point behaves normally), ``seconds`` (stall
+duration; default forever), ``phase`` (``crash_during_save``: ``begin`` dies
+before any bytes are written, default ``commit`` dies between the data
+commit and the manifest write — the classic partial save).
+
+Injection points live in the checkpoint save path, the engine step loop,
+and ``init_distributed``; each is a no-op unless a spec matches, so the
+harness costs nothing in production.
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from .logging import logger
+
+ENV_VAR = "DS_FAULT"
+
+#: exit code used by injected crashes — distinguishable from real signals
+CRASH_EXIT_CODE = 87
+
+
+@dataclass
+class FaultSpec:
+    name: str
+    params: Dict[str, str] = field(default_factory=dict)
+    fired: int = 0  # process-local trigger count (drives ``fails=N``)
+
+    def matches(self, *, step: Optional[int] = None, rank: Optional[int] = None,
+                tag: Optional[str] = None,
+                phase: Optional[str] = None) -> bool:
+        if "step" in self.params and (step is None
+                                      or int(self.params["step"]) != int(step)):
+            return False
+        if "rank" in self.params and (rank is None
+                                      or int(self.params["rank"]) != int(rank)):
+            return False
+        if "tag" in self.params and self.params["tag"] != tag:
+            return False
+        # phase-aware points (crash_during_save: begin|commit) declare their
+        # phase; a spec fires only at its chosen phase (default "commit")
+        if phase is not None and self.params.get("phase", "commit") != phase:
+            return False
+        fails = self.params.get("fails")
+        if fails is not None and self.fired >= int(fails):
+            return False
+        return True
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """Parse the ``DS_FAULT`` grammar; malformed entries raise ValueError
+    (silently dropping a chaos-drill spec would void the drill)."""
+    specs: List[FaultSpec] = []
+    for chunk in (text or "").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        name, params = parts[0].strip(), {}
+        if not name:
+            raise ValueError(f"DS_FAULT: empty fault name in {chunk!r}")
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise ValueError(f"DS_FAULT: expected key=value, got {kv!r}")
+            k, v = kv.split("=", 1)
+            params[k.strip()] = v.strip()
+        specs.append(FaultSpec(name, params))
+    return specs
+
+
+# Parsed specs are cached per env-var VALUE so bounded faults (``fails=N``)
+# keep their trigger counts across calls, while tests that monkeypatch
+# DS_FAULT get a fresh parse.
+_cache: Tuple[Optional[str], List[FaultSpec]] = (None, [])
+
+
+def _specs() -> List[FaultSpec]:
+    global _cache
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return []
+    if _cache[0] != raw:
+        _cache = (raw, parse_faults(raw))
+    return _cache[1]
+
+
+def get_fault(name: str, *, step: Optional[int] = None,
+              rank: Optional[int] = None, tag: Optional[str] = None,
+              phase: Optional[str] = None) -> Optional[FaultSpec]:
+    for spec in _specs():
+        if spec.name == name and spec.matches(step=step, rank=rank, tag=tag,
+                                              phase=phase):
+            return spec
+    return None
+
+
+def reset() -> None:
+    """Forget trigger counts (test isolation)."""
+    global _cache
+    _cache = (None, [])
+
+
+# ---------------------------------------------------------------------------
+# Injection actions
+# ---------------------------------------------------------------------------
+
+
+def maybe_crash(name: str, **ctx: Any) -> None:
+    """Hard process death (no atexit, no orbax flush) — models SIGKILL/OOM."""
+    spec = get_fault(name, **ctx)
+    if spec is None:
+        return
+    spec.fired += 1
+    logger.error(f"DS_FAULT: injected crash at {name} ({ctx})")
+    import sys
+
+    sys.stderr.flush()
+    os._exit(CRASH_EXIT_CODE)
+
+
+def maybe_stall(name: str, **ctx: Any) -> None:
+    """Wedge this process (models a rank stuck in a dead collective)."""
+    spec = get_fault(name, **ctx)
+    if spec is None:
+        return
+    spec.fired += 1
+    seconds = float(spec.params.get("seconds", 10 * 365 * 24 * 3600))
+    logger.error(f"DS_FAULT: injected stall at {name} ({ctx}); "
+                 f"sleeping {seconds:.0f}s")
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        time.sleep(min(1.0, max(0.0, deadline - time.time())))
+
+
+def maybe_fail(name: str, exc: Type[Exception] = OSError, **ctx: Any) -> None:
+    """Raise a (retryable) error — models transient I/O / connect failures."""
+    spec = get_fault(name, **ctx)
+    if spec is None:
+        return
+    spec.fired += 1
+    raise exc(f"DS_FAULT: injected failure at {name} "
+              f"(attempt {spec.fired}, {ctx})")
+
+
+def maybe_corrupt_file(name: str, path: str, **ctx: Any) -> None:
+    """Overwrite the head of ``path`` with garbage (bit-rot / torn write)."""
+    spec = get_fault(name, **ctx)
+    if spec is None or not os.path.exists(path):
+        return
+    spec.fired += 1
+    logger.error(f"DS_FAULT: corrupting {path} ({name})")
+    with open(path, "r+b") as f:
+        f.write(b"\x00CORRUPT\x00")
+
+
+def maybe_truncate_file(name: str, path: str, **ctx: Any) -> None:
+    """Cut ``path`` to half its size (torn non-atomic write)."""
+    spec = get_fault(name, **ctx)
+    if spec is None or not os.path.exists(path):
+        return
+    spec.fired += 1
+    size = os.path.getsize(path)
+    logger.error(f"DS_FAULT: truncating {path} to {size // 2} bytes ({name})")
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+# ---------------------------------------------------------------------------
+# Bounded retry (checkpoint I/O, coordinator connect)
+# ---------------------------------------------------------------------------
+
+
+def retry_with_backoff(fn: Callable[[], Any], *, retries: int = 3,
+                       base_delay: float = 0.5, max_delay: float = 30.0,
+                       what: str = "operation",
+                       exceptions: Sequence[Type[Exception]] = (OSError,)
+                       ) -> Any:
+    """Run ``fn`` with up to ``retries`` retries on transient errors,
+    exponential backoff between attempts. The last failure propagates —
+    bounded, never an infinite loop."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except tuple(exceptions) as e:
+            if attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            attempt += 1
+            logger.warning(f"{what} failed ({type(e).__name__}: {e}); "
+                           f"retry {attempt}/{retries} in {delay:.1f}s")
+            time.sleep(delay)

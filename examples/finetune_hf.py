@@ -22,10 +22,11 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     args = ap.parse_args()
 
-    import jax
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from deepspeed_tpu.utils.jax_compat import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+    import jax
 
     import transformers
 

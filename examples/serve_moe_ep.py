@@ -31,11 +31,11 @@ def main():
     ap.add_argument("--max_new_tokens", type=int, default=16)
     args = ap.parse_args()
 
-    import jax
-
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from deepspeed_tpu.utils.jax_compat import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+    import jax  # noqa: F401 (platform must be pinned before first use)
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import MixtralConfig, MixtralForCausalLM

@@ -16,7 +16,14 @@ import sys
 from typing import Dict, List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CSRC = os.path.join(REPO_ROOT, "csrc")
+# csrc ships INSIDE the deepspeed_tpu package (setuptools package-data is
+# package-relative; the old repo-root location could never reach a wheel,
+# breaking the rebuild-on-foreign-glibc path for pip installs). The repo-root
+# fallback keeps old checkouts working.
+_csrc_candidates = [os.path.join(REPO_ROOT, "deepspeed_tpu", "csrc"),
+                    os.path.join(REPO_ROOT, "csrc")]
+CSRC = next((p for p in _csrc_candidates if os.path.isdir(p)),
+            _csrc_candidates[0])
 BUILD_DIR = os.path.join(CSRC, "build")
 
 

@@ -7,26 +7,35 @@ on them. Must set env BEFORE jax is imported anywhere.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env presets a TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The sandbox may pre-import jax via sitecustomize before env vars can take
+# effect; the backend is still uninitialized at conftest time, so also switch
+# via jax.config (version-tolerant: old jax spells the device count as the
+# XLA flag only).
+from deepspeed_tpu.utils.jax_compat import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
 import jax  # noqa: E402
 
-# The sandbox pre-imports jax via sitecustomize before env vars can take
-# effect; the backend is still uninitialized at conftest time, so switch via
-# jax.config instead.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-
 # Persistent compilation cache: most of the suite's wall-clock is XLA compiles
-# of the same tiny-model programs; warm runs are ~4x faster.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DS_TPU_TEST_COMPILE_CACHE",
-                                 "/tmp/deepspeed_tpu_jax_test_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# of the same tiny-model programs; warm runs are ~4x faster. On jax 0.4.x the
+# cache serializer heap-corrupts multi-device CPU executables (glibc
+# "corrupted double-linked list" aborts mid-suite), so it is opt-in there.
+_cache_dir = os.environ.get("DS_TPU_TEST_COMPILE_CACHE")
+if _cache_dir is None and not jax.__version__.startswith("0.4."):
+    _cache_dir = "/tmp/deepspeed_tpu_jax_test_cache"
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import pytest  # noqa: E402
 

@@ -186,9 +186,9 @@ def test_checkpoint_survives_process_kill(tmp_path):
     import textwrap
 
     script = textwrap.dedent(f"""
+        from deepspeed_tpu.utils.jax_compat import force_cpu_devices
+        force_cpu_devices(8)
         import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
         import os
         import numpy as np
         import deepspeed_tpu as ds

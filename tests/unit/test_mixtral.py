@@ -155,10 +155,10 @@ import os
 # pre-imports jax, so the env var is already absorbed into jax.config —
 # clear it THERE, not in os.environ.
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+from deepspeed_tpu.utils.jax_compat import force_cpu_devices
+force_cpu_devices(8)
 import jax
 jax.config.update("jax_compilation_cache_dir", None)
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import MixtralConfig, MixtralForCausalLM
@@ -347,3 +347,33 @@ def test_decode_gather_path_computes_only_touched_experts():
     out_g, _ = step(params, tok, cache)
     np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_d),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_inference_engine_registers_explicit_mesh_globally():
+    """r5 advisor finding: an InferenceEngine built with an explicitly
+    passed expert-sharded mesh (already matching ep_size, so no rebuild
+    happened) skipped set_mesh — _expert_axis_active() then read
+    get_mesh()==None and the T==1 gather fast path engaged on SHARDED
+    expert weights, adding per-decode-step cross-device weight gathers.
+    The engine must always register its mesh."""
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.models.mixtral as mx
+    from deepspeed_tpu.models import MixtralConfig, MixtralForCausalLM
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.parallel.topology import get_mesh, set_mesh
+
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, 4)))
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    mesh = build_mesh(data=2, expert=4)
+    set_mesh(None, None)  # the engine gets the mesh ONLY via the argument
+    engine = ds.init_inference(model, dtype="fp32", ep_size=4, mesh=mesh,
+                               params=params)
+    assert engine.ep_world_size == 4
+    assert get_mesh() is engine.mesh
+    # the decode-layout check now sees the expert axis → gather fast path
+    # stays OFF for sharded experts
+    assert mx._expert_axis_active()

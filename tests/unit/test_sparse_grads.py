@@ -2,6 +2,8 @@
 ``engine.sparse_allreduce`` ``engine.py:2286-2301``)."""
 
 import jax
+
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -77,7 +79,7 @@ class TestSparseTensor:
             st, _ = SparseTensor.from_dense_bounded(x, capacity=3)
             return sparse_all_reduce(st, "data").to_dense()[None]
 
-        out = jax.jit(jax.shard_map(spmd, mesh=mesh,
+        out = jax.jit(shard_map(spmd, mesh=mesh,
                                     in_specs=P("data"), out_specs=P("data")))(dense)
         expect = jnp.mean(dense, axis=0)
         for shard in range(4):

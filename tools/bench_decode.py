@@ -42,10 +42,11 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
 
     if tiny:
         # smoke mode must not wait on a real accelerator (env vars cannot
-        # switch platforms here; the config route always works)
-        jax.config.update("jax_platforms", "cpu")
-        if ep > 1:
-            jax.config.update("jax_num_cpu_devices", max(ep, 1))
+        # switch platforms here; the config route always works). ep<=1 keeps
+        # the caller's device-count configuration untouched.
+        from deepspeed_tpu.utils.jax_compat import force_cpu_devices
+
+        force_cpu_devices(ep if ep > 1 else None)
 
     import deepspeed_tpu as ds
 

@@ -26,10 +26,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    import jax
+    from deepspeed_tpu.utils.jax_compat import force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    force_cpu_devices(8)
+    import jax
     import flax.linen as nn
     import jax.numpy as jnp
     import numpy as np
