@@ -62,6 +62,9 @@ _LAZY_NAMES = {
     "PipelineModule": (".pipe.module", "PipelineModule"),
     "DeepSpeedConfig": (".runtime.config", "DeepSpeedConfig"),
     "InferenceEngine": (".inference.engine", "InferenceEngine"),
+    "ServingEngine": (".inference.serving", "ServingEngine"),
+    "ServingConfig": (".inference.serving", "ServingConfig"),
+    "init_serving": (".inference.serving", "init_serving"),
 }
 
 

@@ -1,2 +1,12 @@
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine, init_inference  # noqa: F401
+
+
+def __getattr__(name):
+    # serving layer stays lazy: importing inference must not pull the
+    # serving modules until they are used
+    if name in ("ServingEngine", "ServingConfig", "init_serving"):
+        from . import serving
+
+        return getattr(serving, name)
+    raise AttributeError(name)

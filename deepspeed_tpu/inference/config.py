@@ -72,8 +72,24 @@ class DeepSpeedInferenceConfig:
     dequant_per_step: bool = False
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
+    #: bucket generate() shapes to powers of two (prompts left-padded, new
+    #: tokens over-generated and trimmed) so varied request shapes reuse
+    #: cached executables instead of recompiling per exact shape
+    bucket_shapes: bool = True
+    #: shapes <= this compile exactly (their variety is bounded by the
+    #: threshold itself); only larger ones pad to the next power of two
+    bucket_min: int = 8
+    #: decode step loop: "while" exits the step the whole batch has emitted
+    #: EOS (lax.while_loop on done.all(); engaged only when an
+    #: eos_token_id is given — without one the loop can never exit early,
+    #: so the cheaper-to-compile scan runs); "scan" always runs every
+    #: step — keep it if while_loop ever hurts compile time on a backend
+    decode_loop: str = "while"
 
     def __post_init__(self):
+        if self.decode_loop not in ("while", "scan"):
+            raise ValueError(f"decode_loop must be 'while' or 'scan', got "
+                             f"{self.decode_loop!r}")
         self.dtype = resolve_dtype(self.dtype)
         # dtype=int8 means weight quantization, never a value-cast of float
         # weights to int8 (reference auto-sets quantize when dtype==torch.int8).

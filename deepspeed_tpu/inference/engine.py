@@ -28,6 +28,12 @@ from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n — the ONE bucketing primitive shared by
+    ``generate``'s shape buckets and the serving engine's prefill buckets."""
+    return 1 << max(0, (n - 1).bit_length())
+
+
 def _sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int,
                    top_p: float):
     """Greedy / temperature / top-k / top-p sampling, fully inside jit."""
@@ -211,6 +217,35 @@ class InferenceEngine:
                     done = done | (nxt == eos)
                 return (cache, key_mask, nxt, done, cache_index + 1), nxt
 
+            decode_loop = getattr(self.config, "decode_loop", "while")
+            if decode_loop == "while" and max_new_tokens > 1 \
+                    and eos_token_id is not None:
+                # early-exit decode: stop the step every sequence has hit
+                # EOS instead of burning the full max_new_tokens budget.
+                # Without an EOS, done can never fire, so the cheaper-to-
+                # compile scan handles that case. Unwritten tail slots are
+                # prefilled with EOS — exactly what the scan path would
+                # have written after done
+                out0 = jnp.full((B, max_new_tokens), eos,
+                                input_ids.dtype).at[:, 0].set(tok0)
+
+                def cond(carry):
+                    i, _, _, _, done, _, _ = carry
+                    return (i < max_new_tokens) & ~done.all()
+
+                def body(carry):
+                    i, cache, key_mask, tok, done, cache_index, out = carry
+                    (cache, key_mask, nxt, done, cache_index), _ = step(
+                        (cache, key_mask, tok, done, cache_index), rngs[i])
+                    out = jax.lax.dynamic_update_slice(out, nxt[:, None],
+                                                       (0, i))
+                    return (i + 1, cache, key_mask, nxt, done, cache_index,
+                            out)
+
+                final = jax.lax.while_loop(cond, body, (
+                    jnp.int32(1), cache, key_mask, tok0, done0, jnp.int32(T),
+                    out0))
+                return final[-1]
             (_, _, _, _, _), toks = jax.lax.scan(
                 step, (cache, key_mask, tok0, done0, jnp.int32(T)), rngs[1:])
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
@@ -241,6 +276,27 @@ class InferenceEngine:
             attention_mask = jnp.ones((B, T), jnp.int32)
         attention_mask = jnp.asarray(attention_mask, jnp.int32)
 
+        # shape bucketing: prompt_len / max_new_tokens ABOVE bucket_min pad
+        # up to powers of two so varied request shapes hit the SAME cached
+        # executable (a serving mix of, say, 30 distinct prompt lengths
+        # otherwise compiles 30 programs). Shapes <= bucket_min compile
+        # exactly — their variety is bounded by bucket_min itself, and
+        # padding them would only buy extra decode steps. Prompts pad on
+        # the LEFT (the engine's padding convention — positions/key masking
+        # already handle it); over-generated tokens are trimmed before
+        # returning.
+        requested_new = max_new_tokens
+        if getattr(self.config, "bucket_shapes", True):
+            lo = max(1, getattr(self.config, "bucket_min", 8))
+            bucket = lambda n: n if n <= lo else next_pow2(n)
+            Tb = bucket(T)
+            max_new_tokens = bucket(max_new_tokens)
+            if Tb > T:
+                pad = Tb - T
+                input_ids = jnp.pad(input_ids, ((0, 0), (pad, 0)))
+                attention_mask = jnp.pad(attention_mask, ((0, 0), (pad, 0)))
+                T = Tb
+
         key = (B, T, max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
         was_cached = key in self._generate_cache
         fn = self._generate_cache.get(key)
@@ -261,8 +317,9 @@ class InferenceEngine:
                      jax.random.PRNGKey(seed))
             np.asarray(out)  # device fence: measure real latency
             self._model_times.append(_time.perf_counter() - t0)
-            return out
-        return fn(self.params, input_ids, attention_mask, jax.random.PRNGKey(seed))
+            return out[:, :requested_new]
+        return fn(self.params, input_ids, attention_mask,
+                  jax.random.PRNGKey(seed))[:, :requested_new]
 
     # -- parity helpers --------------------------------------------------
 

@@ -1,0 +1,4 @@
+from .block_pool import BlockPool, BlockPoolError  # noqa: F401
+from .scheduler import Request, RequestState, Scheduler  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .engine import ServingConfig, ServingEngine, init_serving  # noqa: F401

@@ -1,0 +1,118 @@
+"""Host-side accounting for the paged KV-cache block pool.
+
+The device arrays (``models/layers.py init_paged_kv_cache``) are a flat pool
+of ``num_blocks`` pages; this class owns WHICH page belongs to WHICH request.
+Every page is always in exactly one place — the free list or the owner map —
+and every transition is validated, so leaks and double-frees are structural
+errors (raised immediately), not silent capacity rot. The serving scheduler
+invariant tests drive random admit/finish/preempt cycles against exactly
+these checks.
+"""
+
+from typing import Dict, List, Optional
+
+
+class BlockPoolError(RuntimeError):
+    """A block-accounting invariant was violated (double-free, foreign free,
+    allocation beyond capacity)."""
+
+
+class BlockPool:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError("num_blocks and block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # popping from the tail keeps allocation ascending-ish (cosmetic)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._owner: Dict[int, str] = {}
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def sentinel(self) -> int:
+        """Block-table entry meaning "unallocated": one past the pool, so
+        appends routed there fall out of bounds and are dropped."""
+        return self.num_blocks
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        """Pages needed to hold ``num_tokens`` positions (>= 1)."""
+        return max(1, -(-num_tokens // self.block_size))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._owner)
+
+    def occupancy(self) -> float:
+        return self.used_count / self.num_blocks
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # -- transitions ---------------------------------------------------
+
+    def allocate(self, n: int, owner: str) -> List[int]:
+        if n < 0:
+            raise ValueError(f"allocate({n})")
+        if n > len(self._free):
+            raise BlockPoolError(
+                f"pool exhausted: want {n} blocks, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        for bid in out:
+            self._owner[bid] = owner
+        return out
+
+    def free(self, block_ids: List[int], owner: str) -> None:
+        seen = set()
+        for bid in block_ids:
+            got = self._owner.get(bid)
+            if got is None or bid in seen:
+                raise BlockPoolError(f"double free of block {bid} ({owner})")
+            if got != owner:
+                raise BlockPoolError(
+                    f"block {bid} owned by {got!r}, freed by {owner!r}")
+            seen.add(bid)
+        for bid in block_ids:
+            del self._owner[bid]
+            self._free.append(bid)
+
+    def owner_of(self, bid: int) -> Optional[str]:
+        return self._owner.get(bid)
+
+    def check_consistent(self) -> None:
+        """Every page in exactly one place; raises on any accounting leak."""
+        free = set(self._free)
+        used = set(self._owner)
+        if len(free) != len(self._free):
+            raise BlockPoolError("free list holds duplicates")
+        if free & used:
+            raise BlockPoolError(f"blocks both free and owned: {free & used}")
+        if len(free) + len(used) != self.num_blocks:
+            missing = set(range(self.num_blocks)) - free - used
+            raise BlockPoolError(f"leaked blocks: {sorted(missing)}")
+
+    # -- defrag --------------------------------------------------------
+
+    def defrag_plan(self):
+        """Compute a compaction: allocated pages move to the lowest ids.
+
+        Returns ``(mapping, src)`` — ``mapping`` is ``{old_id: new_id}`` for
+        every allocated page (callers rewrite block tables with it), and
+        ``src`` is a length-``num_blocks`` gather index such that
+        ``new_pool = old_pool[src]`` realizes the move on the device arrays
+        (untouched positions gather themselves). Accounting is updated
+        here; the caller MUST apply both device-side effects.
+        """
+        allocated = sorted(self._owner)
+        mapping = {old: new for new, old in enumerate(allocated)}
+        src = list(range(self.num_blocks))
+        for old, new in mapping.items():
+            src[new] = old
+        # rebuild accounting in compacted form
+        self._owner = {mapping[old]: who for old, who in self._owner.items()}
+        self._free = list(range(self.num_blocks - 1, len(allocated) - 1, -1))
+        return mapping, src

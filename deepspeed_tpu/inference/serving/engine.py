@@ -1,0 +1,450 @@
+"""Continuous-batching serving engine over a paged KV-cache pool.
+
+The batch-offline ``InferenceEngine.generate`` compiles one program per
+``(batch, prompt_len, max_new_tokens)`` shape and runs every sequence
+lock-step to the longest; this engine instead keeps ONE resident compiled
+decode step whose shapes never change — ``max_batch_size`` slots over a
+shared page pool — and serves arbitrary request mixes by changing only the
+DATA it feeds that step (block tables, context lengths, last tokens). The
+design follows "Ragged Paged Attention" (arxiv 2604.15464): ragged-ness
+lives in indices, not shapes, so heavy mixed traffic never recompiles.
+
+Per :meth:`ServingEngine.step`:
+
+1. **admit** — FIFO queue head(s) get a slot + pages; their prompt runs
+   through a bucketed prefill program (one compile per power-of-two prompt
+   bucket) which appends prompt KV into their pages and samples the first
+   token (TTFT ends here);
+2. **grow/preempt** — every running sequence is guaranteed a page for the
+   token this step appends; when the pool is dry the most-recently-admitted
+   sequence is evicted back to the queue front (recompute-style);
+3. **decode** — the single jitted ragged step appends each slot's last
+   token, runs block-table attention over every layer, and samples the next
+   token for all slots at once; finished sequences (EOS / budget) release
+   slot + pages the same step.
+
+Compile counts are instrumented (the trace-time counter in
+``compile_counts``) so tests can assert the whole mixed-traffic run used
+exactly one compiled decode step.
+"""
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.layers import paged_cache_index
+from ...utils import fault_injection
+from ...utils.logging import log_dist
+from ..engine import InferenceEngine, _sample_logits, next_pow2
+from .block_pool import BlockPool
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestState, Scheduler
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs of the serving layer (the inference config keeps model-level
+    ones: dtype, quantize, ``kv_cache_int8``, mp/ep)."""
+
+    #: decode slots — the fixed batch of the resident decode step
+    max_batch_size: int = 8
+    #: tokens per KV page
+    block_size: int = 16
+    #: pages in the shared pool (total KV capacity = num_blocks * block_size)
+    num_blocks: int = 256
+    #: per-sequence cap on prompt + generated tokens; also fixes the block
+    #: table width (ceil(max_model_len / block_size))
+    max_model_len: int = 512
+    # sampling (static per engine: they shape the compiled programs)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    #: smallest prefill bucket (prompt lengths pad up to powers of two from
+    #: here; each bucket compiles once)
+    prefill_bucket_min: int = 8
+    #: write serving counters to the monitor every N steps (0 = never)
+    monitor_every: int = 1
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    rid: str
+    state: str
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: Optional[str]
+    ttft_s: Optional[float]
+    preemptions: int
+
+
+class ServingEngine:
+    """Continuous-batching front end. Construct from an
+    :class:`InferenceEngine` (or via :func:`init_serving`); drive with
+    :meth:`submit` / :meth:`poll` / :meth:`stream` / :meth:`run`."""
+
+    def __init__(self, engine: InferenceEngine,
+                 config: Optional[ServingConfig] = None, monitor=None):
+        if not isinstance(engine, InferenceEngine):
+            raise TypeError("ServingEngine wraps an InferenceEngine; use "
+                            "init_serving(...) to build both")
+        if not hasattr(engine.module, "init_paged_cache"):
+            raise TypeError(
+                f"{type(engine.module).__name__} has no init_paged_cache: "
+                "paged serving supports the Llama and GPT-2 families")
+        self.engine = engine
+        self.config = config or ServingConfig()
+        self.monitor = monitor
+        cfg = self.config
+        if cfg.max_model_len % cfg.block_size:
+            raise ValueError("max_model_len must be a multiple of block_size")
+
+        self.nb_max = cfg.max_model_len // cfg.block_size
+        self.block_pool = BlockPool(cfg.num_blocks, cfg.block_size)
+        self.sched = Scheduler(cfg.max_batch_size, self.block_pool,
+                               self.nb_max)
+        self.metrics = ServingMetrics(blocks_total=cfg.num_blocks)
+
+        kv_dtype = jnp.int8 if engine.config.kv_cache_int8 \
+            else engine.compute_dtype
+        # committed REPLICATED over the engine mesh: the serving programs
+        # declare replicated in_shardings for the pool (TP shards only the
+        # params), and a single-device-committed pool would conflict
+        self.pool = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, engine._replicated),
+            engine.module.init_paged_cache(cfg.num_blocks, cfg.block_size,
+                                           dtype=kv_dtype))
+
+        B = cfg.max_batch_size
+        self._tables = np.full((B, self.nb_max), self.block_pool.sentinel,
+                               np.int32)
+        self._seq_lens = np.zeros((B,), np.int32)
+        self._last_tok = np.zeros((B,), np.int32)
+
+        self._requests: Dict[str, Request] = {}
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._step_no = 0
+        #: trace-time counters — a retrace IS a recompile, so these count
+        #: XLA compiles of each program kind
+        self.compile_counts = {"decode": 0, "prefill": 0}
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, Any] = {}
+        self._defrag_fn = None
+        # donation lets XLA update the pool in place on TPU; CPU would only
+        # warn that donation is unimplemented
+        self._donate = (1,) if jax.default_backend() != "cpu" else ()
+        log_dist(f"ServingEngine: slots={B}, pool={cfg.num_blocks}x"
+                 f"{cfg.block_size} ({kv_dtype.__name__ if hasattr(kv_dtype, '__name__') else kv_dtype}), "
+                 f"max_len={cfg.max_model_len}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               eos_token_id: Optional[int] = None) -> str:
+        """Enqueue a request; returns its id (admission is FIFO)."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.config.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_model_len={self.config.max_model_len}")
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id)
+        if not self.sched.has_work():
+            # traffic resuming after a drain (or first ever): re-anchor the
+            # throughput window so tokens/sec reflects the current serving
+            # rate instead of decaying across idle gaps
+            self.metrics.on_traffic_resume()
+        self.sched.submit(req)
+        self._requests[req.rid] = req
+        self.metrics.requests_submitted += 1
+        return req.rid
+
+    def poll(self, rid: str) -> RequestOutput:
+        """Non-blocking status + tokens-so-far for a request."""
+        req = self._requests[rid]
+        return RequestOutput(rid=req.rid, state=req.state.value,
+                             prompt=list(req.prompt), tokens=list(req.tokens),
+                             finish_reason=req.finish_reason,
+                             ttft_s=req.ttft, preemptions=req.preemptions)
+
+    def stream(self, rid: str) -> Iterator[int]:
+        """Yield a request's tokens as they are produced, driving the
+        engine's step loop while the request is unfinished."""
+        req = self._requests[rid]
+        sent = 0
+        while True:
+            while sent < len(req.tokens):
+                yield req.tokens[sent]
+                sent += 1
+            if req.state in (RequestState.FINISHED, RequestState.FAILED):
+                return
+            self.step()
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestOutput]:
+        """Drain everything submitted so far; returns all retained outputs
+        (see :meth:`forget` for releasing finished requests on a
+        long-lived server)."""
+        steps = 0
+        while self.sched.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {rid: self.poll(rid) for rid in self._requests}
+
+    def forget(self, rid: str) -> RequestOutput:
+        """Release a FINISHED/FAILED request's retained state (a daemon
+        serving unbounded traffic calls this after consuming the output —
+        nothing is pruned automatically, so poll() keeps working until
+        then). Returns the final output."""
+        req = self._requests[rid]
+        if req.state not in (RequestState.FINISHED, RequestState.FAILED):
+            raise ValueError(f"{rid} is {req.state.value}; only finished/"
+                             "failed requests can be forgotten")
+        out = self.poll(rid)
+        del self._requests[rid]
+        return out
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    # ------------------------------------------------------------------
+    # one scheduler step
+    # ------------------------------------------------------------------
+
+    def step(self) -> None:
+        """Admit + prefill new requests, then run ONE ragged decode step
+        over every active slot."""
+        # chaos-drill point: DS_FAULT=stall:tag=serving_step wedges the
+        # worker here; a bounded stall must leave the queue drainable
+        fault_injection.maybe_stall("stall", tag="serving_step",
+                                    step=self._step_no)
+        t0 = time.perf_counter()
+
+        # 1. FIFO admission + prefill (interleaved with the running batch:
+        # admitted requests join this very step's decode)
+        while True:
+            req = self.sched.admit_next()
+            if req is None:
+                break
+            self._prefill(req)
+
+        # 2. page growth for this step's appends, preempting when dry
+        for _, req in list(self.sched.active()):
+            if req.state is not RequestState.RUNNING:
+                continue  # preempted below while growing an earlier slot
+            while not self.sched.ensure_decode_headroom(req):
+                victim = self.sched.preempt_victim(exclude=req)
+                if victim is None:
+                    # nobody left to evict: the pool cannot hold even one
+                    # sequence at this length — a sizing error, not traffic
+                    slot = req.slot
+                    self.sched.fail(req, "kv_pool_exhausted")
+                    self._clear_slot_arrays(slot)
+                    self.metrics.requests_failed += 1
+                    break
+                self._preempt(victim)
+            else:
+                self._write_table_row(req)  # growth may have added a page
+                continue
+            break
+
+        # 3. the single ragged decode step over all slots
+        active = [(s, r) for s, r in self.sched.active()
+                  if r.state is RequestState.RUNNING]
+        if active:
+            if self._decode_fn is None:
+                self._decode_fn = self._build_decode()
+            self._rng, rng = jax.random.split(self._rng)
+            toks, self.pool = self._decode_fn(
+                self.engine.params, self.pool, jnp.asarray(self._tables),
+                jnp.asarray(self._seq_lens), jnp.asarray(self._last_tok), rng)
+            toks = np.asarray(toks)
+            for slot, req in active:
+                req.seq_len += 1
+                self._seq_lens[slot] = req.seq_len
+                self._harvest(req, int(toks[slot]))
+
+        # 4. bookkeeping
+        self._step_no += 1
+        m = self.metrics
+        m.steps += 1
+        m.record_step(time.perf_counter() - t0)
+        m.queue_depth = self.sched.queue_depth
+        m.active_seqs = len(self.sched.active())
+        m.blocks_used = self.block_pool.used_count
+        if self.monitor is not None and self.config.monitor_every and \
+                self._step_no % self.config.monitor_every == 0:
+            self.monitor.write_events(m.to_events(self._step_no))
+
+    # ------------------------------------------------------------------
+    # defrag
+    # ------------------------------------------------------------------
+
+    def defrag(self) -> int:
+        """Compact allocated pages to the low end of the pool (one gather
+        per pool array) and rewrite the live block tables. Returns the
+        number of pages that moved."""
+        mapping, src = self.block_pool.defrag_plan()
+        moved = sum(1 for old, new in mapping.items() if old != new)
+        if moved:
+            if self._defrag_fn is None:
+                def _gather(pool, src_ids):
+                    # pool arrays carry a leading layer axis: [L, N, ...]
+                    return jax.tree_util.tree_map(
+                        lambda a: jnp.take(a, src_ids, axis=1), pool)
+
+                r = self.engine._replicated
+                self._defrag_fn = jax.jit(_gather,
+                                          donate_argnums=self._donate and (0,),
+                                          in_shardings=(r, r),
+                                          out_shardings=r)
+            self.pool = self._defrag_fn(self.pool, jnp.asarray(src, jnp.int32))
+        for _, req in self.sched.active():
+            req.blocks = [mapping[b] for b in req.blocks]
+            self._write_table_row(req)
+        return moved
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _write_table_row(self, req: Request) -> None:
+        row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)
+        row[:len(req.blocks)] = req.blocks
+        self._tables[req.slot] = row
+
+    def _clear_slot_arrays(self, req_or_slot) -> None:
+        slot = req_or_slot if isinstance(req_or_slot, int) else \
+            req_or_slot.slot
+        if slot is None:
+            return
+        self._tables[slot] = self.block_pool.sentinel
+        self._seq_lens[slot] = 0
+        self._last_tok[slot] = 0
+
+    def _prefill(self, req: Request) -> None:
+        """Run the admitted request's (resume-)prompt through the bucketed
+        prefill program: appends its KV into its pages, samples token one."""
+        tokens = req.resume_tokens
+        L = len(tokens)
+        Tb = next_pow2(max(L, self.config.prefill_bucket_min))
+        self._write_table_row(req)
+        ids = np.zeros((1, Tb), np.int32)
+        ids[0, :L] = tokens
+        fn = self._prefill_fns.get(Tb)
+        if fn is None:
+            fn = self._prefill_fns[Tb] = self._build_prefill(Tb)
+        self._rng, rng = jax.random.split(self._rng)
+        tok, self.pool = fn(self.engine.params, self.pool,
+                            jnp.asarray(self._tables[req.slot][None]),
+                            jnp.asarray(ids), jnp.asarray([L], np.int32), rng)
+        req.seq_len = L
+        self._seq_lens[req.slot] = L
+        self.metrics.prefill_tokens += L
+        self._harvest(req, int(np.asarray(tok)[0]))
+
+    def _harvest(self, req: Request, token: int) -> None:
+        """Account one sampled token; recycle the slot the step a sequence
+        finishes (EOS or token budget)."""
+        req.tokens.append(token)
+        self._last_tok[req.slot] = token
+        self.metrics.tokens_generated += 1
+        self.metrics.window_tokens += 1
+        if req.first_token_time is None:
+            req.first_token_time = time.perf_counter()
+            self.metrics.record_ttft(req.ttft)
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            self._finish(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _finish(self, req: Request, reason: str) -> None:
+        slot = req.slot
+        self.sched.finish(req, reason)
+        self._clear_slot_arrays(slot)
+        self.metrics.requests_completed += 1
+
+    def _preempt(self, req: Request) -> None:
+        slot = req.slot
+        self.sched.preempt(req)
+        self._clear_slot_arrays(slot)
+        self.metrics.preemptions += 1
+
+    # -- compiled programs ---------------------------------------------
+
+    def _dequant(self, qparams):
+        if self.engine._dequant_meta is None:
+            return qparams
+        from ...compression.quantization import dequantize_params
+
+        return dequantize_params(qparams, self.engine._dequant_meta,
+                                 self.engine.compute_dtype)
+
+    def _build_decode(self):
+        module, scfg = self.engine.module, self.config
+
+        def decode(params, pool, tables, seq_lens, last_tok, rng):
+            # trace-time side effect: runs once per XLA compile
+            self.compile_counts["decode"] += 1
+            params = self._dequant(params)
+            idx = paged_cache_index(tables, seq_lens[:, None], seq_lens + 1)
+            logits, pool = module.apply({"params": params},
+                                        last_tok[:, None], cache=pool,
+                                        cache_index=idx)
+            nxt = _sample_logits(logits[:, 0], rng, scfg.do_sample,
+                                 scfg.temperature, scfg.top_k, scfg.top_p)
+            return nxt.astype(jnp.int32), pool
+
+        # explicit shardings, exactly like the dense engine's generate: TP
+        # params keep their NamedShardings (the partitioner inserts the
+        # psums), everything else — pool, tables, lens, tokens — replicates
+        r = self.engine._replicated
+        return jax.jit(decode, donate_argnums=self._donate,
+                       in_shardings=(self.engine.param_shardings,
+                                     r, r, r, r, r),
+                       out_shardings=(r, r))
+
+    def _build_prefill(self, t_bucket: int):
+        module, scfg = self.engine.module, self.config
+
+        def prefill(params, pool, table_row, ids, length, rng):
+            self.compile_counts["prefill"] += 1
+            params = self._dequant(params)
+            ar = jnp.arange(t_bucket)[None, :]
+            append_pos = jnp.where(ar < length[:, None], ar, -1)
+            idx = paged_cache_index(table_row, append_pos, length)
+            logits, pool = module.apply({"params": params}, ids, cache=pool,
+                                        cache_index=idx)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok = _sample_logits(last, rng, scfg.do_sample, scfg.temperature,
+                                 scfg.top_k, scfg.top_p)
+            return tok.astype(jnp.int32), pool
+
+        r = self.engine._replicated
+        return jax.jit(prefill, donate_argnums=self._donate,
+                       in_shardings=(self.engine.param_shardings,
+                                     r, r, r, r, r),
+                       out_shardings=(r, r))
+
+
+def init_serving(model=None, config=None, serving_config=None, monitor=None,
+                 **kwargs) -> ServingEngine:
+    """Build an :class:`InferenceEngine` (same surface as
+    ``deepspeed_tpu.init_inference``) and wrap it for serving."""
+    from ..engine import init_inference
+
+    engine = init_inference(model, config=config, **kwargs)
+    return ServingEngine(engine, config=serving_config, monitor=monitor)

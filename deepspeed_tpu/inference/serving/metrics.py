@@ -1,0 +1,100 @@
+"""Serving counters, exported through the existing ``monitor/`` backends.
+
+The engine updates one ``ServingMetrics`` per step; ``to_events`` renders
+the snapshot as the ``(tag, value, step)`` tuples every monitor backend
+(TensorBoard / W&B / CSV) already consumes — no backend changes needed.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[idx]
+
+
+#: samples kept per latency distribution — bounds both memory and the
+#: per-step sort a monitored engine pays in snapshot() on long-lived servers
+_WINDOW = 4096
+
+
+def _push(values: List[float], x: float) -> None:
+    values.append(x)
+    if len(values) > _WINDOW:
+        del values[:len(values) - _WINDOW]
+
+
+@dataclass
+class ServingMetrics:
+    blocks_total: int = 0
+    # monotone counters
+    requests_submitted: int = 0
+    requests_completed: int = 0
+    requests_failed: int = 0
+    preemptions: int = 0
+    prefill_tokens: int = 0
+    tokens_generated: int = 0
+    steps: int = 0
+    # gauges (overwritten each step)
+    queue_depth: int = 0
+    active_seqs: int = 0
+    blocks_used: int = 0
+    # distributions (windowed to _WINDOW samples — see record_ttft/record_step)
+    ttft_s: List[float] = field(default_factory=list)
+    step_s: List[float] = field(default_factory=list)
+    # throughput window: re-anchored whenever traffic resumes after a
+    # drain, so tokens/sec reflects the CURRENT serving rate instead of
+    # decaying across idle gaps
+    window_start: float = field(default_factory=time.perf_counter)
+    window_tokens: int = 0
+
+    def record_ttft(self, x: float) -> None:
+        _push(self.ttft_s, x)
+
+    def record_step(self, x: float) -> None:
+        _push(self.step_s, x)
+
+    def on_traffic_resume(self) -> None:
+        self.window_start = time.perf_counter()
+        self.window_tokens = 0
+
+    @property
+    def occupancy(self) -> float:
+        return self.blocks_used / self.blocks_total if self.blocks_total else 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        dt = time.perf_counter() - self.window_start
+        return self.window_tokens / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {
+            "queue_depth": float(self.queue_depth),
+            "active_seqs": float(self.active_seqs),
+            "kv_blocks_used": float(self.blocks_used),
+            "kv_block_occupancy": self.occupancy,
+            "tokens_per_sec": self.tokens_per_sec,
+            "tokens_generated": float(self.tokens_generated),
+            "requests_submitted": float(self.requests_submitted),
+            "requests_completed": float(self.requests_completed),
+            "preemptions": float(self.preemptions),
+            "steps": float(self.steps),
+        }
+        if self.ttft_s:
+            out["ttft_p50_s"] = _percentile(self.ttft_s, 0.5)
+            out["ttft_p95_s"] = _percentile(self.ttft_s, 0.95)
+        if self.step_s:
+            out["step_p50_s"] = _percentile(self.step_s, 0.5)
+            out["step_p95_s"] = _percentile(self.step_s, 0.95)
+        return out
+
+    def to_events(self, step: int):
+        """Render as monitor events (``monitor/monitor.py`` Event tuples)."""
+        from ...monitor.monitor import events_from_scalars
+
+        return events_from_scalars(self.snapshot(), step, prefix="serving/")

@@ -1,0 +1,190 @@
+"""Continuous-batching scheduler: FIFO admission, slot recycling, preemption.
+
+Pure host-side bookkeeping (no jax): which request sits in which decode
+slot, which pool pages it owns, and who gets evicted when the pool runs
+dry. The serving engine (``engine.py``) owns the device programs and calls
+into this state machine once per step.
+
+Policy, in the vLLM lineage the paged pool comes from:
+
+- **FIFO admission**: only the queue HEAD is considered; if it does not fit
+  (no slot, or not enough free pages for its prompt) nothing behind it is
+  admitted either — head-of-line blocking is what keeps admission FIFO.
+- **Slot recycling**: a sequence that finishes (EOS / token budget) frees
+  its slot and pages the same step, so the next step can admit from queue.
+- **Preemption-with-requeue**: when a RUNNING sequence needs one more page
+  and the pool is dry, the most-recently-admitted other sequence is
+  evicted: its pages are freed and it returns to the FRONT of the queue
+  carrying ``prompt + generated`` so re-admission re-prefills and resumes
+  exactly where it stopped (recompute-style preemption — no KV swapping).
+"""
+
+import enum
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+from .block_pool import BlockPool
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    rid: str = field(default_factory=lambda: f"req-{next(_rid_counter)}")
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = field(default_factory=list)   # generated so far
+    slot: Optional[int] = None
+    blocks: List[int] = field(default_factory=list)
+    seq_len: int = 0          # tokens whose KV sits in the pool
+    submit_time: float = field(default_factory=time.perf_counter)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    preemptions: int = 0
+    admit_order: int = -1     # monotone stamp set at admission (victim pick)
+
+    @property
+    def resume_tokens(self) -> List[int]:
+        """What a (re-)prefill replays: the prompt plus everything already
+        generated — recompute-style preemption resumes exactly here."""
+        return self.prompt + self.tokens
+
+    @property
+    def remaining_new(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+class Scheduler:
+    def __init__(self, num_slots: int, pool: BlockPool,
+                 max_blocks_per_seq: int):
+        self.num_slots = num_slots
+        self.pool = pool
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * num_slots
+        self.admit_log: List[str] = []   # rids in true admission order
+        self._admit_stamp = itertools.count()
+
+    # -- introspection -------------------------------------------------
+
+    def active(self) -> List[Tuple[int, Request]]:
+        return [(i, r) for i, r in enumerate(self.slots) if r is not None]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.slots)
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    # -- admission (FIFO) ----------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        need = self.pool.blocks_for_tokens(len(req.prompt) + req.max_new_tokens)
+        if need > min(self.max_blocks_per_seq, self.pool.num_blocks):
+            raise ValueError(
+                f"request {req.rid} needs {need} KV blocks at its length "
+                f"cap; the pool serves at most "
+                f"{min(self.max_blocks_per_seq, self.pool.num_blocks)} per "
+                f"sequence (raise num_blocks/max_model_len)")
+        self.queue.append(req)
+
+    def admit_next(self) -> Optional[Request]:
+        """Admit the queue HEAD if a slot and its prefill pages are free;
+        None otherwise (nothing behind the head is considered — FIFO)."""
+        if not self.queue:
+            return None
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        req = self.queue[0]
+        need = self.pool.blocks_for_tokens(len(req.resume_tokens))
+        if not self.pool.can_allocate(need):
+            return None
+        self.queue.popleft()
+        req.blocks = self.pool.allocate(need, req.rid)
+        req.slot = slot
+        req.state = RequestState.RUNNING
+        req.admit_order = next(self._admit_stamp)
+        self.slots[slot] = req
+        self.admit_log.append(req.rid)
+        if len(self.admit_log) > 65536:  # bounded on long-lived servers
+            del self.admit_log[:len(self.admit_log) - 65536]
+        return req
+
+    # -- decode-time page growth / preemption --------------------------
+
+    def ensure_decode_headroom(self, req: Request) -> bool:
+        """Make sure the page holding position ``seq_len`` exists (the next
+        decode step appends there). False = pool dry, caller must preempt."""
+        need_idx = req.seq_len // self.pool.block_size
+        while len(req.blocks) <= need_idx:
+            if not self.pool.can_allocate(1):
+                return False
+            req.blocks.extend(self.pool.allocate(1, req.rid))
+        return True
+
+    def preempt_victim(self, exclude: Request) -> Optional[Request]:
+        """Most-recently-admitted running request other than ``exclude``."""
+        candidates = [r for _, r in self.active() if r is not exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.admit_order)
+
+    def preempt(self, req: Request) -> None:
+        """Evict: free pages + slot, requeue at the FRONT carrying progress."""
+        self.pool.free(req.blocks, req.rid)
+        self.slots[req.slot] = None
+        req.blocks = []
+        req.slot = None
+        req.seq_len = 0
+        req.state = RequestState.QUEUED
+        req.preemptions += 1
+        self.queue.appendleft(req)
+
+    # -- completion ----------------------------------------------------
+
+    def finish(self, req: Request, reason: str) -> None:
+        self.pool.free(req.blocks, req.rid)
+        self.slots[req.slot] = None
+        req.blocks = []
+        req.slot = None
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.finish_time = time.perf_counter()
+
+    def fail(self, req: Request, reason: str) -> None:
+        if req.slot is not None:
+            self.pool.free(req.blocks, req.rid)
+            self.slots[req.slot] = None
+            req.blocks = []
+            req.slot = None
+        req.state = RequestState.FAILED
+        req.finish_reason = reason
+        req.finish_time = time.perf_counter()

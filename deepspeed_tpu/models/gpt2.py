@@ -17,8 +17,9 @@ from jax.sharding import PartitionSpec as P
 from .layers import (cached_attention_xla,
                      flash_prefill_from_empty,
                      cross_entropy_loss, dot_product_attention,
-                     init_kv_cache,
-                     shift_labels, update_kv_cache)
+                     init_kv_cache, init_paged_kv_cache, is_paged_index,
+                     key_mask_to_bias, paged_attention_reference,
+                     shift_labels, update_kv_cache, update_paged_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,7 +67,26 @@ class GPT2Attention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        if layer_cache is not None:
+        if layer_cache is not None and is_paged_index(cache_index):
+            # paged serving path (inference/serving/): see LlamaAttention
+            layer_cache = update_paged_kv_cache(layer_cache, k, v, cache_index)
+            if T == 1:
+                out = paged_attention_reference(
+                    q[:, 0], layer_cache, cache_index["block_tables"],
+                    cache_index["context_len"])[:, None]
+            else:
+                # from-empty prefill: fresh K/V attention == cache attention
+                key_mask = (cache_index["append_pos"] >= 0).astype(jnp.int32)
+                if cfg.prefill_flash_from_empty:
+                    # masked flash kernel: no [B, H, T, T] logits tensor at
+                    # serving prompt lengths (same gate as the dense branch)
+                    out = flash_prefill_from_empty(q, k, v,
+                                                   key_mask=key_mask)
+                else:
+                    out = dot_product_attention(
+                        q, k, v, bias=key_mask_to_bias(key_mask),
+                        causal=True)
+        elif layer_cache is not None:
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
             if T > 1 and cfg.prefill_flash_from_empty:
                 # from-empty prefill via the masked flash kernel (no
@@ -141,8 +161,11 @@ class GPT2LMHeadModel(nn.Module):
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte", param_dtype=jnp.float32)
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, name="wpe", param_dtype=jnp.float32)
         if positions is None:
-            start = 0 if cache_index is None else cache_index
-            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
+            if cache_index is not None and is_paged_index(cache_index):
+                positions = jnp.maximum(cache_index["append_pos"], 0)
+            else:
+                start = 0 if cache_index is None else cache_index
+                positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
         x = wte(input_ids) + wpe(positions)
         # causality is applied inside the attention core (flash-compatible);
         # the bias only carries the padding mask (cached path: raw [B, S] mask)
@@ -194,6 +217,14 @@ class GPT2LMHeadModel(nn.Module):
         cfg = self.config
         return init_kv_cache(batch, max_len, cfg.n_head, cfg.n_embd // cfg.n_head,
                              n_layers=cfg.n_layer, dtype=dtype)
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.bfloat16):
+        """Empty paged KV pool for the continuous-batching serving engine."""
+        cfg = self.config
+        return init_paged_kv_cache(num_blocks, block_size, cfg.n_head,
+                                   cfg.n_embd // cfg.n_head,
+                                   n_layers=cfg.n_layer, dtype=dtype)
 
     @staticmethod
     def partition_rules(config: GPT2Config):

@@ -332,6 +332,152 @@ def update_kv_cache(layer_cache, k, v, cache_index):
     }
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (serving layer)
+#
+# The serving engine (inference/serving/) replaces the dense per-call cache
+# with a PREALLOCATED block pool shared by every in-flight request: pages of
+# ``block_size`` token positions, indexed per sequence through a block table.
+# Layout ``[L?, N, Hkv, bs, D]`` keeps the same well-tiled minor dims
+# ``(bs, D)`` as the dense head-major cache, so the Pallas paged decode
+# kernel's ``(1, 1, bs, D)`` blocks tile identically (see
+# ``ops/pallas/decode_attention.py paged_decode_attention``). The shape of
+# the fix follows "Ragged Paged Attention" (arxiv 2604.15464): one
+# fixed-shape decode step serves arbitrary mixes of sequence lengths via
+# block-table indexing, with no per-shape recompilation.
+# ---------------------------------------------------------------------------
+
+
+def init_paged_kv_cache(num_blocks: int, block_size: int, num_kv_heads: int,
+                        head_dim: int, n_layers: Optional[int] = None,
+                        dtype=jnp.bfloat16):
+    """Allocate an empty paged KV pool ``[L?, N, Hkv, bs, D]``.
+
+    ``dtype=jnp.int8`` mirrors the dense ``init_kv_cache`` int8 contract:
+    values are absmax-quantized per (position, kv head) at append time with
+    fp32 scales stored alongside (``[L?, N, Hkv, bs]``).
+    """
+    shape = (num_blocks, num_kv_heads, block_size, head_dim)
+    sshape = (num_blocks, num_kv_heads, block_size)
+    if n_layers is not None:
+        shape = (n_layers,) + shape
+        sshape = (n_layers,) + sshape
+    if dtype == jnp.int8:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_cache_index(block_tables: jnp.ndarray, append_pos: jnp.ndarray,
+                      context_len: jnp.ndarray):
+    """Bundle the per-sequence paging state that rides through the model as
+    ``cache_index`` (a plain dict threads the flax scan carry unchanged).
+
+    ``block_tables``: int32 ``[B, nb_max]`` pool page ids per sequence; the
+    sentinel value ``num_blocks`` (one past the pool) marks unallocated
+    entries — appends routed there are DROPPED by the scatter and gathers
+    clamp to a real page that the context-length mask then hides.
+    ``append_pos``: int32 ``[B, T]`` absolute position of each incoming
+    token (``-1`` = padding, its KV write is dropped).
+    ``context_len``: int32 ``[B]`` number of valid cached tokens AFTER this
+    append (prefill: the prompt length; decode: ``seq_len + 1``).
+    """
+    return {"block_tables": jnp.asarray(block_tables, jnp.int32),
+            "append_pos": jnp.asarray(append_pos, jnp.int32),
+            "context_len": jnp.asarray(context_len, jnp.int32)}
+
+
+def is_paged_index(cache_index) -> bool:
+    """True when ``cache_index`` is a paged-cache bundle (vs a scalar)."""
+    return isinstance(cache_index, dict) and "block_tables" in cache_index
+
+
+def update_paged_kv_cache(layer_cache, k, v, cache_index):
+    """Append fresh ``[B, T, Hkv, D]`` keys/values into the block pool.
+
+    Each token scatters to ``pool[table[pos // bs], :, pos % bs]``; invalid
+    tokens (``append_pos < 0``) and unallocated table entries (the
+    ``num_blocks`` sentinel) map out of bounds, which JAX scatter DROPS —
+    inactive decode slots and prompt padding cost nothing and corrupt
+    nothing. An int8 pool quantizes at append (absmax per token, kv head).
+    """
+    num_blocks, _, bs, _ = layer_cache["k"].shape
+    pos = cache_index["append_pos"]                       # [B, T]
+    blk = jnp.maximum(pos, 0) // bs
+    off = jnp.maximum(pos, 0) % bs
+    bids = jnp.take_along_axis(
+        cache_index["block_tables"],
+        jnp.minimum(blk, cache_index["block_tables"].shape[1] - 1), axis=1)
+    # drop pads AND positions beyond the table width (over-length appends
+    # must never alias another sequence's page)
+    valid = (pos >= 0) & (blk < cache_index["block_tables"].shape[1])
+    bids = jnp.where(valid, bids, num_blocks)             # OOB -> dropped
+    if "k_scale" in layer_cache:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        return {
+            "k": layer_cache["k"].at[bids, :, off, :].set(kq, mode="drop"),
+            "v": layer_cache["v"].at[bids, :, off, :].set(vq, mode="drop"),
+            "k_scale": layer_cache["k_scale"].at[bids, :, off].set(
+                ks, mode="drop"),
+            "v_scale": layer_cache["v_scale"].at[bids, :, off].set(
+                vs, mode="drop"),
+        }
+    return {
+        "k": layer_cache["k"].at[bids, :, off, :].set(
+            k.astype(layer_cache["k"].dtype), mode="drop"),
+        "v": layer_cache["v"].at[bids, :, off, :].set(
+            v.astype(layer_cache["v"].dtype), mode="drop"),
+    }
+
+
+def paged_attention_reference(q, layer_cache, block_tables, context_len,
+                              window: Optional[int] = None,
+                              scale: Optional[float] = None):
+    """Single-position attention over the paged pool, pure-XLA fallback.
+
+    ``q``: ``[B, H, D]`` (the one new token's heads, ALREADY appended to the
+    pool); gathers each sequence's pages into dense ``[B, Hkv, S, D]`` rows
+    (S = nb_max * bs) and masks ``kv_pos >= context_len``. Runs everywhere;
+    the TPU path is the block-table Pallas kernel
+    (``ops/pallas/decode_attention.py paged_decode_attention``).
+    """
+    num_blocks, Hkv, bs, D = layer_cache["k"].shape
+    bt = jnp.minimum(jnp.asarray(block_tables, jnp.int32), num_blocks - 1)
+    B, nb = bt.shape
+    S = nb * bs
+    k = layer_cache["k"][bt]                              # [B, nb, Hkv, bs, D]
+    v = layer_cache["v"][bt]
+    if "k_scale" in layer_cache:
+        k = dequantize_kv(k, layer_cache["k_scale"][bt], q.dtype)
+        v = dequantize_kv(v, layer_cache["v_scale"][bt], q.dtype)
+    else:
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    k = jnp.swapaxes(k, 1, 2).reshape(B, Hkv, S, D)
+    v = jnp.swapaxes(v, 1, 2).reshape(B, Hkv, S, D)
+    H = q.shape[1]
+    rep = H // Hkv
+    if rep > 1:
+        k = jnp.broadcast_to(k[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, H, S, D)
+        v = jnp.broadcast_to(v[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, H, S, D)
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    clen = jnp.asarray(context_len, jnp.int32)
+    kv_pos = jnp.arange(S)[None, :]
+    visible = kv_pos < clen[:, None]
+    if window is not None:
+        visible = visible & ((clen[:, None] - 1 - kv_pos) < window)
+    bias = jnp.where(visible, 0.0, -1e9).astype(jnp.float32)[:, None, :]
+    logits = jnp.einsum("bhd,bhsd->bhs", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(logits + bias, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bhsd->bhd", probs, v)
+
+
 def key_mask_to_bias(attention_mask: jnp.ndarray) -> jnp.ndarray:
     """[B, S] 1/0 key mask -> additive [B, 1, 1, S] bias (0 keep, -1e9 drop).
     The ONE conversion used by every entry point that accepts a key mask."""

@@ -24,9 +24,11 @@ from jax.sharding import PartitionSpec as P
 from .layers import (RMSNorm, apply_rotary,
                      cached_attention_xla, flash_prefill_from_empty,
                      cross_entropy_loss, lm_head_output,
-                     dot_product_attention, init_kv_cache, repeat_kv,
+                     dot_product_attention, init_kv_cache,
+                     init_paged_kv_cache, is_paged_index, key_mask_to_bias,
+                     paged_attention_reference, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
-                     update_kv_cache)
+                     update_kv_cache, update_paged_kv_cache)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,7 +134,49 @@ class LlamaAttention(nn.Module):
         v = dense(Hkv * D, "v_proj", qb)(x).reshape(B, T, Hkv, D)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        if layer_cache is not None:
+        if layer_cache is not None and is_paged_index(cache_index):
+            # paged serving path (inference/serving/): KV appends scatter
+            # into the shared block pool through this sequence's block
+            # table; ragged-ness (per-sequence lengths) lives in the index
+            # bundle, so ONE compiled step serves any mix of lengths
+            layer_cache = update_paged_kv_cache(layer_cache, k, v, cache_index)
+            if T == 1:
+                if cfg.decode_attention_impl == "pallas":
+                    from ..ops.pallas.decode_attention import \
+                        paged_decode_attention
+
+                    out = paged_decode_attention(
+                        q[:, 0], layer_cache["k"], layer_cache["v"],
+                        cache_index["block_tables"],
+                        cache_index["context_len"],
+                        k_scale=layer_cache.get("k_scale"),
+                        v_scale=layer_cache.get("v_scale"),
+                        window=cfg.sliding_window)[:, None]
+                else:
+                    out = paged_attention_reference(
+                        q[:, 0], layer_cache, cache_index["block_tables"],
+                        cache_index["context_len"],
+                        window=cfg.sliding_window)[:, None]
+            else:
+                # serving prefill always starts a sequence from an EMPTY
+                # span of pages, so attention over the FRESH K/V equals
+                # cache attention (the prefill_flash_from_empty contract);
+                # pads carry append_pos = -1
+                key_mask = (cache_index["append_pos"] >= 0).astype(jnp.int32)
+                if cfg.prefill_flash_from_empty:
+                    # same gate as the dense branch: the masked flash
+                    # kernel avoids the [B, H, T, T] logits tensor the XLA
+                    # path materializes at serving prompt lengths
+                    out = flash_prefill_from_empty(
+                        q, k, v, key_mask=key_mask,
+                        block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                        window=cfg.sliding_window)
+                else:
+                    out = dot_product_attention(
+                        q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv),
+                        bias=key_mask_to_bias(key_mask), causal=True,
+                        window=cfg.sliding_window)
+        elif layer_cache is not None:
             # decode / cached-prefill path (reference: softmax_context KV-cache
             # append, pt_binding.cpp). mask carries the [B, S] key-padding mask.
             layer_cache = update_kv_cache(layer_cache, k, v, cache_index)
@@ -250,8 +294,13 @@ class LlamaModel(nn.Module):
             # gemma: hidden states scaled by sqrt(hidden) in the embed dtype
             x = x * jnp.asarray(cfg.embed_scale, x.dtype)
         if positions is None:
-            start = 0 if cache_index is None else cache_index
-            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
+            if cache_index is not None and is_paged_index(cache_index):
+                # paged serving: each token's absolute position IS its
+                # append slot (pads, marked -1, are masked anyway)
+                positions = jnp.maximum(cache_index["append_pos"], 0)
+            else:
+                start = 0 if cache_index is None else cache_index
+                positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
         cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, dtype=x.dtype)
         # causality is applied inside the attention core (flash-compatible);
         # the bias only carries the padding mask (cached path: raw [B, S] mask)
@@ -335,6 +384,14 @@ class LlamaForCausalLM(nn.Module):
         cfg = self.config
         return init_kv_cache(batch, max_len, cfg.num_key_value_heads, cfg.head_dim,
                              n_layers=cfg.num_hidden_layers, dtype=dtype)
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.bfloat16):
+        """Empty paged KV pool for the continuous-batching serving engine."""
+        cfg = self.config
+        return init_paged_kv_cache(num_blocks, block_size,
+                                   cfg.num_key_value_heads, cfg.head_dim,
+                                   n_layers=cfg.num_hidden_layers, dtype=dtype)
 
     @staticmethod
     def partition_rules(config: LlamaConfig):

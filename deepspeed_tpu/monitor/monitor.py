@@ -15,6 +15,14 @@ from ..utils.logging import logger
 Event = Tuple[str, float, int]
 
 
+def events_from_scalars(scalars, step: int, prefix: str = "") -> List[Event]:
+    """Render a ``{name: value}`` dict as monitor events — the serving
+    layer's counters (queue depth, TTFT, KV occupancy, tokens/sec) flow to
+    every enabled backend through this without backend changes."""
+    return [(prefix + name, float(value), step)
+            for name, value in sorted(scalars.items()) if value is not None]
+
+
 class Monitor:
     def __init__(self, config):
         self.config = config

@@ -229,3 +229,173 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         interpret=interpret,
     )(cidx, qg, k_cache, v_cache, *scales, key_mask)
     return out.reshape(B, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) decode attention — the serving layer's kernel
+#
+# Same online-softmax pass as the dense kernel above, but the KV operand is
+# the SHARED block pool ``[N, Hkv, bs, D]`` (models/layers.py
+# init_paged_kv_cache) and each grid step ``ik`` DMAs the page named by the
+# sequence's block table instead of a contiguous cache stripe. This is the
+# TPU-native shape of "Ragged Paged Attention" (arxiv 2604.15464): one
+# fixed-shape program serves every mix of sequence lengths — ragged-ness
+# lives entirely in the prefetched block tables / context lengths, never in
+# the compiled shape.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, *rest,
+                         sm_scale: float, block_size: int, window,
+                         int8: bool):
+    if int8:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    clen = cl_ref[b]
+    # pages wholly beyond the context are skipped (their index map revisits
+    # the last real page, so the DMA is also elided); with a sliding window
+    # pages wholly below it are skipped too
+    run = ik * block_size < clen
+    if window is not None:
+        run = run & ((ik + 1) * block_size > clen - 1 - window)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)      # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)      # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)      # [bs, D]
+        if int8:
+            k = k * ks_ref[0, 0][:, None]
+            v = v * vs_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + ik * block_size
+        valid = cols < clen
+        if window is not None:  # query position is clen - 1
+            valid = valid & (clen - 1 - cols < window)
+        s = jnp.where(valid, s, NEG_INF)
+        # freed/unwritten page tails hold stale-but-finite values (pools are
+        # zero-initialized and only ever hold real appends), so masked p==0
+        # rows cannot poison dot(p, v) the way hardware edge padding can
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                           context_lens: jnp.ndarray,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None,
+                           force_pallas: bool = False,
+                           window: Optional[int] = None,
+                           k_scale: Optional[jnp.ndarray] = None,
+                           v_scale: Optional[jnp.ndarray] = None
+                           ) -> jnp.ndarray:
+    """Single-position attention over a paged KV pool via block tables.
+
+    ``q``: ``[B, H, D]``; ``k_pages``/``v_pages``: ``[N, Hkv, bs, D]`` (the
+    ``init_paged_kv_cache`` pool, new token ALREADY appended);
+    ``block_tables``: int32 ``[B, nb_max]`` page ids (``N`` = unallocated
+    sentinel); ``context_lens``: int32 ``[B]`` valid tokens per sequence
+    including the new one. Returns ``[B, H, D]``.
+
+    An int8 pool passes ``k_scale``/``v_scale`` ``[N, Hkv, bs]``; pages are
+    dequantized per block in VMEM (HBM reads stay int8). ``interpret=None``
+    auto-selects: real kernel on TPU, the gather-based XLA reference
+    (``models/layers.py paged_attention_reference``) elsewhere.
+    """
+    int8 = k_scale is not None
+    if interpret is None:
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu and not force_pallas:
+            from ...models.layers import paged_attention_reference
+
+            cache = {"k": k_pages, "v": v_pages}
+            if int8:
+                cache["k_scale"], cache["v_scale"] = k_scale, v_scale
+            return paged_attention_reference(q, cache, block_tables,
+                                             context_lens, window=window,
+                                             scale=sm_scale)
+        interpret = not on_tpu
+    B, H, D = q.shape
+    N, Hkv, bs, _ = k_pages.shape
+    if H % Hkv:
+        raise ValueError(f"query heads {H} must divide into kv heads {Hkv}")
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    nb = block_tables.shape[1]
+
+    qg = q.reshape(B, Hkv, G, D)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    clen = jnp.asarray(context_lens, jnp.int32)
+
+    # Grid steps beyond a sequence's context revisit its LAST real page (the
+    # DMA is skipped — Pallas elides copies of an already-resident block);
+    # sentinel table entries clamp to a real page whose contents the
+    # in-kernel context mask hides. Per-sequence work therefore grows with
+    # the REAL context, not nb_max * bs.
+    def kv_idx(b, h, ik, bt_ref, cl_ref):
+        last = jnp.maximum(cl_ref[b] - 1, 0) // bs
+        pid = bt_ref[b, jnp.minimum(ik, last)]
+        return (jnp.minimum(pid, N - 1), h, 0, 0)
+
+    def scale_idx(b, h, ik, bt_ref, cl_ref):
+        last = jnp.maximum(cl_ref[b] - 1, 0) // bs
+        pid = bt_ref[b, jnp.minimum(ik, last)]
+        return (jnp.minimum(pid, N - 1), h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D), kv_idx),
+        pl.BlockSpec((1, 1, bs, D), kv_idx),
+    ]
+    if int8:
+        in_specs += [pl.BlockSpec((1, 1, bs), scale_idx)] * 2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    scales = []
+    if int8:
+        scales = [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                          block_size=bs, window=window, int8=int8),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=interpret,
+    )(bt, clen, qg, k_pages, v_pages, *scales)
+    return out.reshape(B, H, D)

@@ -1,0 +1,100 @@
+"""Block-pool accounting invariants: no page is ever leaked, double-freed,
+or owned by two sequences — enforced structurally and exercised
+property-style with random allocate/free cycles."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.serving.block_pool import BlockPool, BlockPoolError
+
+pytestmark = pytest.mark.serving
+
+
+def test_basic_alloc_free_occupancy():
+    pool = BlockPool(8, 16)
+    assert pool.sentinel == 8
+    assert pool.blocks_for_tokens(1) == 1
+    assert pool.blocks_for_tokens(16) == 1
+    assert pool.blocks_for_tokens(17) == 2
+    a = pool.allocate(3, "a")
+    b = pool.allocate(2, "b")
+    assert len(set(a) | set(b)) == 5  # disjoint
+    assert pool.used_count == 5 and pool.free_count == 3
+    assert pool.occupancy() == 5 / 8
+    pool.free(a, "a")
+    assert pool.used_count == 2
+    pool.check_consistent()
+
+
+def test_double_free_and_foreign_free_raise():
+    pool = BlockPool(4, 8)
+    a = pool.allocate(2, "a")
+    pool.free(a, "a")
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free(a, "a")
+    b = pool.allocate(1, "b")
+    with pytest.raises(BlockPoolError, match="owned by"):
+        pool.free(b, "intruder")
+    # the failed foreign free must not have mutated anything
+    pool.check_consistent()
+    assert pool.used_count == 1
+    # duplicate ids WITHIN one free() call are a double free too
+    c = pool.allocate(1, "c")
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free(c + c, "c")
+    pool.check_consistent()
+    assert pool.used_count == 2
+
+
+def test_exhaustion_raises_and_can_allocate():
+    pool = BlockPool(4, 8)
+    assert pool.can_allocate(4) and not pool.can_allocate(5)
+    pool.allocate(3, "a")
+    with pytest.raises(BlockPoolError, match="exhausted"):
+        pool.allocate(2, "b")
+    pool.check_consistent()
+
+
+def test_property_random_cycles_never_leak():
+    """Random allocate/free interleavings across many owners: after every
+    operation the pool partitions exactly into free + owned."""
+    rs = np.random.RandomState(0)
+    pool = BlockPool(32, 8)
+    live = {}
+    for step in range(500):
+        if live and (rs.rand() < 0.45 or pool.free_count == 0):
+            owner = rs.choice(sorted(live))
+            pool.free(live.pop(owner), owner)
+        else:
+            n = int(rs.randint(1, 5))
+            owner = f"req-{step}"
+            if pool.can_allocate(n):
+                live[owner] = pool.allocate(n, owner)
+        pool.check_consistent()
+        owned = [b for bs in live.values() for b in bs]
+        assert len(owned) == len(set(owned)) == pool.used_count
+    for owner, bs in live.items():
+        pool.free(bs, owner)
+    pool.check_consistent()
+    assert pool.used_count == 0
+
+
+def test_defrag_plan_compacts_and_preserves_ownership():
+    pool = BlockPool(16, 8)
+    a = pool.allocate(3, "a")
+    b = pool.allocate(3, "b")
+    pool.free(a, "a")          # holes at the low end
+    mapping, src = pool.defrag_plan()
+    pool.check_consistent()
+    # b's pages now occupy the lowest ids, ownership preserved
+    assert sorted(mapping[x] for x in b) == [0, 1, 2]
+    for x in b:
+        assert pool.owner_of(mapping[x]) == "b"
+    # src realizes the move: new_pool[new] = old_pool[old]
+    for old, new in mapping.items():
+        assert src[new] == old
+    assert len(src) == 16
+    # subsequent allocation starts right after the compacted span
+    c = pool.allocate(2, "c")
+    assert min(c) >= 3
+    pool.check_consistent()

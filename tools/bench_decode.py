@@ -88,9 +88,13 @@ def run_point(batch: int, prompt: int, new: int, tiny: bool,
     ids = rs.randint(0, cfg.vocab_size, (batch, prompt))
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  jax.numpy.asarray(ids[:1]))["params"]
+    # bucket_shapes=False: the bench measures EXACTLY the requested
+    # (prompt, new) shape — pow-of-two padding would silently time a
+    # different program (max_new_tokens=1 would run 8 decode steps)
     engine = ds.init_inference(model, params=params, dtype="bf16",
                                max_out_tokens=prompt + new,
-                               kv_cache_int8=kv_int8, ep_size=ep)
+                               kv_cache_int8=kv_int8, ep_size=ep,
+                               bucket_shapes=False)
 
     def best_of(fn, n=3):
         """min over repeats — single-shot timings at millisecond scale are
