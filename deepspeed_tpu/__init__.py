@@ -65,6 +65,7 @@ _LAZY_NAMES = {
     "ServingEngine": (".inference.serving", "ServingEngine"),
     "ServingConfig": (".inference.serving", "ServingConfig"),
     "init_serving": (".inference.serving", "init_serving"),
+    "RejectedError": (".inference.serving", "RejectedError"),
 }
 
 

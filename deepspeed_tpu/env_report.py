@@ -64,6 +64,29 @@ def env_info():
         pass
 
 
+def fault_report() -> None:
+    """Print the active ``DS_FAULT`` spec (parsed), so a chaos run's logs
+    are self-describing: ds_report output pasted into an incident doc says
+    exactly which faults were armed."""
+    from deepspeed_tpu.utils import fault_injection
+
+    raw = os.environ.get(fault_injection.ENV_VAR)
+    if not raw:
+        print("fault injection (DS_FAULT): none")
+        return
+    try:
+        specs = fault_injection.parse_faults(raw)
+    except ValueError as e:
+        print(f"fault injection (DS_FAULT): {raw!r} MALFORMED — {e}")
+        return
+    print(f"fault injection (DS_FAULT): {raw}")
+    for s in specs:
+        params = ", ".join(f"{k}={v}"
+                           for k, v in sorted(s.params.items())) or \
+            "unconditional"
+        print(f"  armed: {s.name} ({params})")
+
+
 def checkpoint_report(ckpt_dir: str) -> int:
     """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
     every save's manifest in a checkpoint dir, print the last-good tag.
@@ -128,6 +151,7 @@ def main(argv=None):
     print("DeepSpeed-TPU environment report (ds_report)")
     print("=" * 60)
     env_info()
+    fault_report()
     op_report()
     return 0
 

@@ -82,6 +82,23 @@ class InferenceEngine:
         self.mp_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
         self.ep_world_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("expert", 1)
 
+        # TP degree beyond the KV-head count splits individual GQA heads
+        # across shards; XLA's SPMD partitioner then mis-partitions the
+        # repeat_kv broadcast-reshape and the forward silently computes
+        # WRONG logits (r7 TP-numerics investigation: max |dlogit| ~2.4 on
+        # the tiny model at mp=4/Hkv=2, vs ~1e-6 whenever mp | Hkv). Warn
+        # loudly until kv-head replication lands.
+        n_kv = getattr(getattr(module, "config", None),
+                       "num_key_value_heads", None)
+        if n_kv is not None and self.mp_world_size > 1 and \
+                n_kv % self.mp_world_size != 0:
+            log_dist(
+                f"WARNING: mp_size={self.mp_world_size} does not divide "
+                f"num_key_value_heads={n_kv}: GQA kv heads shard unevenly "
+                f"and TP logits are known to diverge from single-device "
+                f"(see ROADMAP: TP numerics). Use mp_size <= {n_kv} with "
+                f"mp_size | {n_kv}.", ranks=[0])
+
         # ---- shard + cast params (reference: _convert_to_dtype :464 and
         # ReplaceWithTensorSlicing per-rank slicing) -----------------------
         rules = None

@@ -1,4 +1,6 @@
 from .block_pool import BlockPool, BlockPoolError  # noqa: F401
-from .scheduler import Request, RequestState, Scheduler  # noqa: F401
+from .scheduler import (RejectedError, Request, RequestState,  # noqa: F401
+                        Scheduler, TERMINAL_STATES)
 from .metrics import ServingMetrics  # noqa: F401
-from .engine import ServingConfig, ServingEngine, init_serving  # noqa: F401
+from .engine import (ServingConfig, ServingEngine,  # noqa: F401
+                     StepWatchdogTimeout, init_serving)

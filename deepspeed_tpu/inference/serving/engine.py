@@ -26,9 +26,35 @@ Per :meth:`ServingEngine.step`:
 Compile counts are instrumented (the trace-time counter in
 ``compile_counts``) so tests can assert the whole mixed-traffic run used
 exactly one compiled decode step.
+
+Overload control and fault recovery (the resilience contract):
+
+- **deadlines** — ``submit(..., deadline_s=)``; queued requests past
+  deadline are shed at the admission gate, running ones end in terminal
+  ``TIMEOUT`` with their pages returned;
+- **admission control** — bounded queue depth + KV-headroom gate; rejects
+  raise :class:`RejectedError` (or ``try_submit`` returns None); a
+  higher-priority submit displaces the lowest-priority queued request
+  instead of being rejected;
+- **graceful degradation** — preemption and shedding take lowest-priority
+  newest work first; a brownout (manual or occupancy-triggered) caps every
+  admission's token budget; ``drain()`` stops admitting, sheds the queue
+  and finishes residents;
+- **step watchdog + output guard** — a wall-clock watchdog thread bounds
+  the resident decode step (a wedged/slow step fails ITS requests and the
+  engine keeps serving; abandoned results are discarded — the watchdog
+  forces pool donation off so that is always safe — and while the
+  abandoned thread is still wedged no new one is stacked), and a NaN/Inf
+  logit guard quarantines the offending request instead of poisoning the
+  batch;
+- **chaos points** — ``DS_FAULT=stall|slow_step|corrupt_logits|
+  flaky_prefill`` (plus ``p=`` probabilistic variants) exercise all of the
+  above; the chaos suite asserts every request reaches a terminal state
+  and zero pages leak under any injected fault.
 """
 
 import dataclasses
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -40,9 +66,13 @@ from ...models.layers import paged_cache_index
 from ...utils import fault_injection
 from ...utils.logging import log_dist
 from ..engine import InferenceEngine, _sample_logits, next_pow2
-from .block_pool import BlockPool
+from .block_pool import BlockPool, BlockPoolError
 from .metrics import ServingMetrics
-from .scheduler import Request, RequestState, Scheduler
+from .scheduler import RejectedError, Request, RequestState, Scheduler
+
+
+class StepWatchdogTimeout(RuntimeError):
+    """The resident decode step exceeded ``step_watchdog_s`` wall-clock."""
 
 
 @dataclasses.dataclass
@@ -70,6 +100,29 @@ class ServingConfig:
     prefill_bucket_min: int = 8
     #: write serving counters to the monitor every N steps (0 = never)
     monitor_every: int = 1
+    # -- overload control / resilience ---------------------------------
+    #: queued requests beyond this are rejected (0 = unbounded); a
+    #: higher-priority submit displaces the lowest-priority queued request
+    #: instead of bouncing
+    max_queue_depth: int = 0
+    #: KV-headroom admission gate: keep at least this many pool blocks
+    #: clear of committed demand (used pages + every queued prefill + the
+    #: newcomer's prefill); None disables the gate
+    kv_headroom_blocks: Optional[int] = None
+    #: deadline applied to submits that do not pass their own (seconds
+    #: from submit; None = no deadline)
+    default_deadline_s: Optional[float] = None
+    #: brownout auto-engages when pool occupancy reaches this fraction
+    #: (None = only via set_brownout(True))
+    brownout_occupancy: Optional[float] = None
+    #: token budget cap applied to admissions while browned out
+    brownout_max_new_tokens: int = 8
+    #: wall-clock budget for one resident decode step; past it the step's
+    #: requests fail and serving continues (0 = no watchdog)
+    step_watchdog_s: float = 0.0
+    #: quarantine requests whose logits go NaN/Inf instead of emitting
+    #: garbage tokens
+    logit_guard: bool = True
 
 
 @dataclasses.dataclass
@@ -129,15 +182,28 @@ class ServingEngine:
         self._requests: Dict[str, Request] = {}
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._step_no = 0
+        self._draining = False
+        #: manual brownout override: None = automatic (occupancy), else forced
+        self._brownout_forced: Optional[bool] = None
         #: trace-time counters — a retrace IS a recompile, so these count
         #: XLA compiles of each program kind
         self.compile_counts = {"decode": 0, "prefill": 0}
+        #: first decode call carries the XLA compile and is never
+        #: watchdog-judged (heartbeat.py's first-beat rule)
+        self._decode_warm = False
+        #: the one abandoned watchdog thread, if still wedged in device
+        #: compute — bounds thread growth to 1 under a persistent hang
+        self._wedged: Optional[threading.Thread] = None
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
         self._defrag_fn = None
         # donation lets XLA update the pool in place on TPU; CPU would only
-        # warn that donation is unimplemented
-        self._donate = (1,) if jax.default_backend() != "cpu" else ()
+        # warn that donation is unimplemented. With the step watchdog armed
+        # donation stays OFF even on TPU: an abandoned (timed-out) step must
+        # be discardable, which needs functional — not in-place — pool
+        # updates; the price is one pool copy per step.
+        self._donate = (1,) if jax.default_backend() != "cpu" \
+            and not cfg.step_watchdog_s else ()
         log_dist(f"ServingEngine: slots={B}, pool={cfg.num_blocks}x"
                  f"{cfg.block_size} ({kv_dtype.__name__ if hasattr(kv_dtype, '__name__') else kv_dtype}), "
                  f"max_len={cfg.max_model_len}", ranks=[0])
@@ -147,19 +213,91 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens: int = 16,
-               eos_token_id: Optional[int] = None) -> str:
-        """Enqueue a request; returns its id (admission is FIFO)."""
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> str:
+        """Enqueue a request; returns its id (admission is FIFO within a
+        priority). Raises :class:`RejectedError` when admission control
+        refuses the request (queue full / KV headroom / draining) — use
+        :meth:`try_submit` for a non-raising variant. ``deadline_s`` is a
+        total-latency budget from now; a request still queued or decoding
+        past it ends in terminal ``TIMEOUT``."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        # coerce EVERY caller-supplied field up front: a malformed argument
+        # must raise before the admission gates shed displacement victims
+        max_new_tokens = int(max_new_tokens)
+        priority = int(priority)
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) + max_new_tokens > self.config.max_model_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_model_len={self.config.max_model_len}")
+        # per-sequence page-cap validation BEFORE the admission gates: a
+        # caller error must never fire after displacement victims were
+        # already shed (the scheduler re-checks as a backstop)
+        need_cap = self.block_pool.blocks_for_tokens(
+            len(prompt) + max_new_tokens)
+        if need_cap > min(self.nb_max, self.block_pool.num_blocks):
+            raise ValueError(
+                f"request needs {need_cap} KV blocks at its length cap; "
+                f"the pool serves at most "
+                f"{min(self.nb_max, self.block_pool.num_blocks)} per "
+                f"sequence (raise num_blocks/max_model_len)")
+        cfg = self.config
+        if self._draining:
+            self.metrics.requests_rejected += 1
+            raise RejectedError("draining", "engine is draining; "
+                                "no new admissions")
+        # Both admission gates honor priority displacement: a newcomer that
+        # outranks queued work sheds it (lowest priority first, newest
+        # within a tier) rather than being rejected. Victims for BOTH
+        # gates are selected as a DRY RUN and only cancelled once the
+        # newcomer is known to pass every gate — a reject must never
+        # destroy queued work.
+        victims: List[Request] = []
+        displaceable = self.sched.displaceable(priority)
+        if cfg.kv_headroom_blocks is not None:
+            budget = self.block_pool.num_blocks - cfg.kv_headroom_blocks
+            demand = (self.block_pool.used_count
+                      + self.sched.queued_block_demand()
+                      + self.block_pool.blocks_for_tokens(len(prompt)))
+            for v in displaceable:
+                if demand <= budget:
+                    break
+                victims.append(v)
+                demand -= self.block_pool.blocks_for_tokens(
+                    len(v.resume_tokens))
+            if demand > budget:
+                self.metrics.requests_rejected += 1
+                raise RejectedError(
+                    "kv_headroom", f"committed KV demand {demand} "
+                    f"blocks exceeds admission budget {budget} "
+                    f"(pool {self.block_pool.num_blocks} - headroom "
+                    f"{cfg.kv_headroom_blocks})")
+        if cfg.max_queue_depth and \
+                self.sched.queue_depth - len(victims) >= cfg.max_queue_depth:
+            extra = next((v for v in displaceable if v not in victims), None)
+            if extra is None:
+                self.metrics.requests_rejected += 1
+                raise RejectedError(
+                    "queue_full", f"queue depth {self.sched.queue_depth} at "
+                    f"cap {cfg.max_queue_depth}")
+            victims.append(extra)
+        for v in victims:
+            self.sched.cancel(v, "shed_overload")
+            self.metrics.requests_shed += 1
+        if deadline_s is None:
+            deadline_s = cfg.default_deadline_s
+        deadline = None if deadline_s is None \
+            else time.perf_counter() + float(deadline_s)
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                      eos_token_id=eos_token_id)
+                      eos_token_id=eos_token_id, priority=priority,
+                      deadline=deadline)
         if not self.sched.has_work():
             # traffic resuming after a drain (or first ever): re-anchor the
             # throughput window so tokens/sec reflects the current serving
@@ -169,6 +307,71 @@ class ServingEngine:
         self._requests[req.rid] = req
         self.metrics.requests_submitted += 1
         return req.rid
+
+    def try_submit(self, prompt_ids, max_new_tokens: int = 16,
+                   eos_token_id: Optional[int] = None,
+                   deadline_s: Optional[float] = None,
+                   priority: int = 0) -> Optional[str]:
+        """Backpressure-friendly submit: None instead of RejectedError when
+        admission control sheds the request (malformed requests still raise
+        ValueError — those are caller bugs, not load)."""
+        try:
+            return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                               eos_token_id=eos_token_id,
+                               deadline_s=deadline_s, priority=priority)
+        except RejectedError:
+            return None
+
+    def cancel(self, rid: str, reason: str = "cancelled") -> bool:
+        """Cancel a request in ANY live state: queued requests leave the
+        queue, running ones release slot + pages the same call. Returns
+        False when the request already reached a terminal state (cancel is
+        then a no-op — its outcome stands)."""
+        req = self._requests[rid]
+        if req.done:
+            return False
+        slot = req.slot
+        self.sched.cancel(req, reason)
+        if slot is not None:
+            self._clear_slot_arrays(slot)
+        self.metrics.requests_cancelled += 1
+        return True
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[str, "RequestOutput"]:
+        """Graceful shutdown: stop admitting (submits now raise
+        ``RejectedError("draining")``), shed everything still queued, and
+        step until every resident finishes. Returns all retained outputs.
+        ``resume_admission()`` reopens the engine."""
+        self._draining = True
+        for req in list(self.sched.queue):
+            self.sched.cancel(req, "drained")
+            self.metrics.requests_shed += 1
+        steps = 0
+        # has_work(), not "slots occupied": a resident preempted-and-
+        # requeued mid-drain sits in the QUEUE between steps and must still
+        # be driven to a terminal state
+        while self.sched.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {rid: self.poll(rid) for rid in self._requests}
+
+    def resume_admission(self) -> None:
+        """Reopen admission after :meth:`drain`."""
+        self._draining = False
+
+    def set_brownout(self, on: Optional[bool]) -> None:
+        """Force brownout on/off; ``None`` returns to automatic
+        (occupancy-triggered via ``brownout_occupancy``)."""
+        self._brownout_forced = on
+
+    @property
+    def brownout(self) -> bool:
+        if self._brownout_forced is not None:
+            return self._brownout_forced
+        thr = self.config.brownout_occupancy
+        return thr is not None and self.block_pool.occupancy() >= thr
 
     def poll(self, rid: str) -> RequestOutput:
         """Non-blocking status + tokens-so-far for a request."""
@@ -187,7 +390,7 @@ class ServingEngine:
             while sent < len(req.tokens):
                 yield req.tokens[sent]
                 sent += 1
-            if req.state in (RequestState.FINISHED, RequestState.FAILED):
+            if req.done:
                 return
             self.step()
 
@@ -204,14 +407,15 @@ class ServingEngine:
         return {rid: self.poll(rid) for rid in self._requests}
 
     def forget(self, rid: str) -> RequestOutput:
-        """Release a FINISHED/FAILED request's retained state (a daemon
-        serving unbounded traffic calls this after consuming the output —
-        nothing is pruned automatically, so poll() keeps working until
-        then). Returns the final output."""
+        """Release a request's retained state (a daemon serving unbounded
+        traffic calls this after consuming the output — nothing is pruned
+        automatically, so poll() keeps working until then). A request still
+        live (queued, preempted-requeued, or mid-decode) is cancelled
+        first, so its slot and pages always return to the pool. Returns the
+        final output."""
         req = self._requests[rid]
-        if req.state not in (RequestState.FINISHED, RequestState.FAILED):
-            raise ValueError(f"{rid} is {req.state.value}; only finished/"
-                             "failed requests can be forgotten")
+        if not req.done:
+            self.cancel(rid, "forgotten")
         out = self.poll(rid)
         del self._requests[rid]
         return out
@@ -225,22 +429,74 @@ class ServingEngine:
 
     def step(self) -> None:
         """Admit + prefill new requests, then run ONE ragged decode step
-        over every active slot."""
+        over every active slot — bounded by deadlines, the step watchdog
+        and the logit guard, so one pathological request or one wedged
+        step never takes the engine down."""
         # chaos-drill point: DS_FAULT=stall:tag=serving_step wedges the
         # worker here; a bounded stall must leave the queue drainable
         fault_injection.maybe_stall("stall", tag="serving_step",
                                     step=self._step_no)
         t0 = time.perf_counter()
 
-        # 1. FIFO admission + prefill (interleaved with the running batch:
-        # admitted requests join this very step's decode)
+        # 1. deadline sweep: queued requests past deadline are shed at the
+        # gate; running ones end terminal TIMEOUT, pages back to the pool
+        now = time.perf_counter()
+        self.sched.expire_queued(now)
+        for slot, req in list(self.sched.active()):
+            if req.state is RequestState.RUNNING and req.expired(now):
+                self.sched.timeout(req, "deadline")
+                self._clear_slot_arrays(slot)
+                self.metrics.requests_timeout += 1
+        # 1b. wedged-backend gate, BEFORE any device dispatch: while the
+        # previously-abandoned (watchdog-tripped) step is still stuck in
+        # device compute, neither prefill nor decode may touch the backend
+        # — an unguarded prefill against a hung device would wedge the
+        # main thread, the very failure the watchdog exists to survive.
+        # Host-side work above (deadline shedding) still ran; the sleep
+        # keeps drive loops from spinning.
+        if self._wedged is not None:
+            if self._wedged.is_alive():
+                self.metrics.watchdog_skips += 1
+                time.sleep(min(0.05, self.config.step_watchdog_s))
+                self._account_reaped()
+                # no record_step: a skipped step's sleep in the latency
+                # distribution would read as HEALTHY p50 mid-outage;
+                # watchdog_skips is the signal for this condition
+                self._finish_step_bookkeeping(t0, self.brownout,
+                                              record_latency=False)
+                return
+            self._wedged = None
+
+        # 2. FIFO admission + prefill (interleaved with the running batch:
+        # admitted requests join this very step's decode); brownout caps
+        # each admission's remaining token budget
+        brownout = self.brownout
         while True:
             req = self.sched.admit_next()
             if req is None:
                 break
-            self._prefill(req)
+            if brownout:
+                capped = len(req.tokens) + self.config.brownout_max_new_tokens
+                if capped < req.max_new_tokens:
+                    req.max_new_tokens = capped
+                    self.metrics.brownout_admissions += 1
+            try:
+                self._prefill(req)
+            except BlockPoolError:
+                raise  # accounting invariant broken — never swallow
+            except Exception as e:
+                # a failing prefill (flaky_prefill chaos, OOM on one
+                # pathological prompt, ...) fails ITS request; the engine
+                # keeps serving everyone else
+                log_dist(f"serving: prefill failed for {req.rid}: "
+                         f"{type(e).__name__}: {e}", ranks=[0])
+                slot = req.slot
+                self.sched.fail(req, f"prefill_error:{type(e).__name__}")
+                self._clear_slot_arrays(slot)
+                self.metrics.requests_failed += 1
+        self._account_reaped()
 
-        # 2. page growth for this step's appends, preempting when dry
+        # 3. page growth for this step's appends, preempting when dry
         for _, req in list(self.sched.active()):
             if req.state is not RequestState.RUNNING:
                 continue  # preempted below while growing an earlier slot
@@ -260,30 +516,94 @@ class ServingEngine:
                 continue
             break
 
-        # 3. the single ragged decode step over all slots
+        # 4. the single ragged decode step over all slots, watchdog-bounded
         active = [(s, r) for s, r in self.sched.active()
                   if r.state is RequestState.RUNNING]
         if active:
             if self._decode_fn is None:
                 self._decode_fn = self._build_decode()
             self._rng, rng = jax.random.split(self._rng)
-            toks, self.pool = self._decode_fn(
-                self.engine.params, self.pool, jnp.asarray(self._tables),
-                jnp.asarray(self._seq_lens), jnp.asarray(self._last_tok), rng)
-            toks = np.asarray(toks)
-            for slot, req in active:
-                req.seq_len += 1
-                self._seq_lens[slot] = req.seq_len
-                self._harvest(req, int(toks[slot]))
+            corrupt = np.zeros((self.config.max_batch_size,), bool)
+            spec = fault_injection.maybe_flag("corrupt_logits",
+                                              tag="serving_step",
+                                              step=self._step_no)
+            if spec is not None:
+                # NaN ONE slot's logits (spec may pin slot=N); the guard
+                # must quarantine that request, not the batch. A pin that
+                # is malformed, out of range, or names an empty slot falls
+                # back to the first active slot — an injection point must
+                # never crash the serving loop it is drilling
+                active_slots = {s for s, _ in active}
+                try:
+                    pin = int(spec.params["slot"])
+                except (KeyError, ValueError):
+                    pin = active[0][0]
+                if pin not in active_slots:
+                    pin = active[0][0]
+                corrupt[pin] = True
+            step_no = self._step_no
+            # snapshot everything the guarded thread touches on THIS thread:
+            # after a watchdog trip the main loop moves on, and the
+            # abandoned thread must not read engine state mid-mutation
+            pool = self.pool
+            tables = jnp.asarray(self._tables)
+            seq_lens = jnp.asarray(self._seq_lens)
+            last_tok = jnp.asarray(self._last_tok)
 
-        # 4. bookkeeping
+            def device_step():
+                # chaos point INSIDE the guarded region: a slow/wedged
+                # step is exactly what the watchdog exists for
+                fault_injection.maybe_stall("slow_step", tag="serving_step",
+                                            step=step_no)
+                return self._decode_fn(self.engine.params, pool,
+                                       tables, seq_lens, last_tok,
+                                       jnp.asarray(corrupt), rng)
+
+            try:
+                # heartbeat.py's first-beat rule, in-process: the first
+                # decode invocation contains the XLA compile (often far
+                # beyond any sane step budget) and is never watchdog-judged;
+                # steady-state wedges — the r5 outage class — always are
+                if self._decode_warm:
+                    toks, bad, self.pool = self._guarded(device_step)
+                else:
+                    toks, bad, self.pool = device_step()
+                    self._decode_warm = True
+            except StepWatchdogTimeout as e:
+                log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
+                self.metrics.watchdog_trips += 1
+                for slot, req in active:
+                    self.sched.fail(req, "step_watchdog")
+                    self._clear_slot_arrays(slot)
+                    self.metrics.requests_failed += 1
+            else:
+                toks = np.asarray(toks)
+                bad = np.asarray(bad)
+                for slot, req in active:
+                    if self.config.logit_guard and bad[slot]:
+                        self.sched.fail(req, "corrupt_logits")
+                        self._clear_slot_arrays(slot)
+                        self.metrics.logit_quarantines += 1
+                        self.metrics.requests_failed += 1
+                        continue
+                    req.seq_len += 1
+                    self._seq_lens[slot] = req.seq_len
+                    self._harvest(req, int(toks[slot]))
+
+        # 5. bookkeeping
+        self._finish_step_bookkeeping(t0, brownout)
+
+    def _finish_step_bookkeeping(self, t0: float, brownout: bool,
+                                 record_latency: bool = True) -> None:
         self._step_no += 1
         m = self.metrics
         m.steps += 1
-        m.record_step(time.perf_counter() - t0)
+        if record_latency:
+            m.record_step(time.perf_counter() - t0)
         m.queue_depth = self.sched.queue_depth
         m.active_seqs = len(self.sched.active())
         m.blocks_used = self.block_pool.used_count
+        m.brownout_active = brownout
         if self.monitor is not None and self.config.monitor_every and \
                 self._step_no % self.config.monitor_every == 0:
             self.monitor.write_events(m.to_events(self._step_no))
@@ -320,6 +640,49 @@ class ServingEngine:
     # internals
     # ------------------------------------------------------------------
 
+    def _account_reaped(self) -> None:
+        """Count the requests the scheduler shed at the admission gate
+        (deadline-expired while queued) this step."""
+        if self.sched.reaped:
+            self.metrics.requests_timeout += len(self.sched.reaped)
+            self.sched.reaped.clear()
+
+    def _guarded(self, fn):
+        """Run the device step under the wall-clock watchdog (the
+        staleness-judgment pattern of ``elasticity/heartbeat.py``, applied
+        in-process): past ``step_watchdog_s`` the step is abandoned and
+        :class:`StepWatchdogTimeout` raised — the caller fails the step's
+        requests and keeps serving. Abandoned results are simply discarded:
+        the watchdog forces donation OFF (see ``__init__``), so pool
+        updates are functional and dropping one is always safe. The worker
+        thread only reads snapshots taken by the caller, never live engine
+        state."""
+        timeout = self.config.step_watchdog_s
+        if not timeout or timeout <= 0:
+            return fn()
+        box: Dict[str, Any] = {}
+
+        def run():
+            try:
+                box["out"] = fn()
+            except BaseException as e:  # surfaced on the caller thread
+                box["err"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="serving-step-watchdog")
+        t.start()
+        t.join(timeout)
+        # a step that lands between the join timeout and these checks is
+        # kept — barely-late work beats a spurious failure
+        if "err" in box:
+            raise box["err"]
+        if "out" in box:
+            return box["out"]
+        self._wedged = t  # step() skips decode while this is still alive
+        raise StepWatchdogTimeout(
+            f"decode step exceeded {timeout:.3f}s wall-clock "
+            f"(step {self._step_no})")
+
     def _write_table_row(self, req: Request) -> None:
         row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)
         row[:len(req.blocks)] = req.blocks
@@ -336,7 +699,13 @@ class ServingEngine:
 
     def _prefill(self, req: Request) -> None:
         """Run the admitted request's (resume-)prompt through the bucketed
-        prefill program: appends its KV into its pages, samples token one."""
+        prefill program: appends its KV into its pages, samples token one.
+        NaN/Inf logits quarantine the request (terminal FAILED, pages
+        returned) instead of poisoning its stream."""
+        # chaos point: DS_FAULT=flaky_prefill raises here; step() fails the
+        # request and keeps serving
+        fault_injection.maybe_fail("flaky_prefill", exc=RuntimeError,
+                                   tag="serving_prefill", step=self._step_no)
         tokens = req.resume_tokens
         L = len(tokens)
         Tb = next_pow2(max(L, self.config.prefill_bucket_min))
@@ -347,12 +716,20 @@ class ServingEngine:
         if fn is None:
             fn = self._prefill_fns[Tb] = self._build_prefill(Tb)
         self._rng, rng = jax.random.split(self._rng)
-        tok, self.pool = fn(self.engine.params, self.pool,
-                            jnp.asarray(self._tables[req.slot][None]),
-                            jnp.asarray(ids), jnp.asarray([L], np.int32), rng)
+        tok, bad, self.pool = fn(self.engine.params, self.pool,
+                                 jnp.asarray(self._tables[req.slot][None]),
+                                 jnp.asarray(ids), jnp.asarray([L], np.int32),
+                                 rng)
         req.seq_len = L
         self._seq_lens[req.slot] = L
         self.metrics.prefill_tokens += L
+        if self.config.logit_guard and bool(np.asarray(bad)[0]):
+            slot = req.slot
+            self.sched.fail(req, "corrupt_logits")
+            self._clear_slot_arrays(slot)
+            self.metrics.logit_quarantines += 1
+            self.metrics.requests_failed += 1
+            return
         self._harvest(req, int(np.asarray(tok)[0]))
 
     def _harvest(self, req: Request, token: int) -> None:
@@ -395,7 +772,7 @@ class ServingEngine:
     def _build_decode(self):
         module, scfg = self.engine.module, self.config
 
-        def decode(params, pool, tables, seq_lens, last_tok, rng):
+        def decode(params, pool, tables, seq_lens, last_tok, corrupt, rng):
             # trace-time side effect: runs once per XLA compile
             self.compile_counts["decode"] += 1
             params = self._dequant(params)
@@ -403,9 +780,16 @@ class ServingEngine:
             logits, pool = module.apply({"params": params},
                                         last_tok[:, None], cache=pool,
                                         cache_index=idx)
-            nxt = _sample_logits(logits[:, 0], rng, scfg.do_sample,
+            last = logits[:, 0]
+            # corrupt_logits chaos: NaN the flagged slots' logits as DATA
+            # (the mask is an input, so the drill never recompiles)
+            last = jnp.where(corrupt[:, None],
+                             jnp.asarray(jnp.nan, last.dtype), last)
+            # output guard: per-slot NaN/Inf flag, computed on-device
+            bad = ~jnp.isfinite(last).all(axis=-1)
+            nxt = _sample_logits(last, rng, scfg.do_sample,
                                  scfg.temperature, scfg.top_k, scfg.top_p)
-            return nxt.astype(jnp.int32), pool
+            return nxt.astype(jnp.int32), bad, pool
 
         # explicit shardings, exactly like the dense engine's generate: TP
         # params keep their NamedShardings (the partitioner inserts the
@@ -413,8 +797,8 @@ class ServingEngine:
         r = self.engine._replicated
         return jax.jit(decode, donate_argnums=self._donate,
                        in_shardings=(self.engine.param_shardings,
-                                     r, r, r, r, r),
-                       out_shardings=(r, r))
+                                     r, r, r, r, r, r),
+                       out_shardings=(r, r, r))
 
     def _build_prefill(self, t_bucket: int):
         module, scfg = self.engine.module, self.config
@@ -429,15 +813,16 @@ class ServingEngine:
                                         cache_index=idx)
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            bad = ~jnp.isfinite(last).all(axis=-1)
             tok = _sample_logits(last, rng, scfg.do_sample, scfg.temperature,
                                  scfg.top_k, scfg.top_p)
-            return tok.astype(jnp.int32), pool
+            return tok.astype(jnp.int32), bad, pool
 
         r = self.engine._replicated
         return jax.jit(prefill, donate_argnums=self._donate,
                        in_shardings=(self.engine.param_shardings,
                                      r, r, r, r, r),
-                       out_shardings=(r, r))
+                       out_shardings=(r, r, r))
 
 
 def init_serving(model=None, config=None, serving_config=None, monitor=None,

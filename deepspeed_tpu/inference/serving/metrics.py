@@ -36,6 +36,18 @@ class ServingMetrics:
     requests_submitted: int = 0
     requests_completed: int = 0
     requests_failed: int = 0
+    #: overload-control counters — the observability half of the resilience
+    #: contract (shed = load shedding + drain, rejected = admission control)
+    requests_timeout: int = 0
+    requests_cancelled: int = 0
+    requests_shed: int = 0
+    requests_rejected: int = 0
+    watchdog_trips: int = 0
+    #: steps whose decode was skipped because the previously-abandoned
+    #: (watchdog-tripped) step was still wedged in device compute
+    watchdog_skips: int = 0
+    logit_quarantines: int = 0
+    brownout_admissions: int = 0
     preemptions: int = 0
     prefill_tokens: int = 0
     tokens_generated: int = 0
@@ -44,6 +56,7 @@ class ServingMetrics:
     queue_depth: int = 0
     active_seqs: int = 0
     blocks_used: int = 0
+    brownout_active: bool = False
     # distributions (windowed to _WINDOW samples — see record_ttft/record_step)
     ttft_s: List[float] = field(default_factory=list)
     step_s: List[float] = field(default_factory=list)
@@ -82,6 +95,16 @@ class ServingMetrics:
             "tokens_generated": float(self.tokens_generated),
             "requests_submitted": float(self.requests_submitted),
             "requests_completed": float(self.requests_completed),
+            "requests_failed": float(self.requests_failed),
+            "requests_timeout": float(self.requests_timeout),
+            "requests_cancelled": float(self.requests_cancelled),
+            "requests_shed": float(self.requests_shed),
+            "requests_rejected": float(self.requests_rejected),
+            "watchdog_trips": float(self.watchdog_trips),
+            "watchdog_skips": float(self.watchdog_skips),
+            "logit_quarantines": float(self.logit_quarantines),
+            "brownout_admissions": float(self.brownout_admissions),
+            "brownout_active": float(self.brownout_active),
             "preemptions": float(self.preemptions),
             "steps": float(self.steps),
         }

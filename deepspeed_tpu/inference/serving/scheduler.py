@@ -13,10 +13,15 @@ Policy, in the vLLM lineage the paged pool comes from:
 - **Slot recycling**: a sequence that finishes (EOS / token budget) frees
   its slot and pages the same step, so the next step can admit from queue.
 - **Preemption-with-requeue**: when a RUNNING sequence needs one more page
-  and the pool is dry, the most-recently-admitted other sequence is
-  evicted: its pages are freed and it returns to the FRONT of the queue
-  carrying ``prompt + generated`` so re-admission re-prefills and resumes
-  exactly where it stopped (recompute-style preemption — no KV swapping).
+  and the pool is dry, the lowest-priority (then most-recently-admitted)
+  other sequence is evicted: its pages are freed and it returns to the
+  FRONT of the queue carrying ``prompt + generated`` so re-admission
+  re-prefills and resumes exactly where it stopped (recompute-style
+  preemption — no KV swapping).
+- **Deadlines + terminal discipline**: queued requests past deadline are
+  shed at the admission gate (terminal ``TIMEOUT``); every terminal
+  transition (finish/fail/timeout/cancel) funnels through ``_release`` so
+  pages ALWAYS return to the pool — the chaos-suite invariant.
 """
 
 import enum
@@ -34,6 +39,22 @@ class RequestState(enum.Enum):
     RUNNING = "running"
     FINISHED = "finished"
     FAILED = "failed"
+    TIMEOUT = "timeout"       # deadline expired (queued or mid-decode)
+    CANCELLED = "cancelled"   # caller cancel() / load shed / drain
+
+
+#: every request ends in exactly one of these — the chaos-suite invariant
+TERMINAL_STATES = frozenset({RequestState.FINISHED, RequestState.FAILED,
+                             RequestState.TIMEOUT, RequestState.CANCELLED})
+
+
+class RejectedError(RuntimeError):
+    """Admission control refused a submit (queue full / KV headroom /
+    draining). ``reason`` carries the machine-readable cause."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
 
 
 _rid_counter = itertools.count()
@@ -44,6 +65,11 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    #: larger = more important; shedding and preemption take the smallest
+    #: priority first (ties: newest admitted / newest submitted)
+    priority: int = 0
+    #: absolute ``time.perf_counter()`` stamp; None = no deadline
+    deadline: Optional[float] = None
     rid: str = field(default_factory=lambda: f"req-{next(_rid_counter)}")
     state: RequestState = RequestState.QUEUED
     tokens: List[int] = field(default_factory=list)   # generated so far
@@ -56,6 +82,15 @@ class Request:
     finish_reason: Optional[str] = None
     preemptions: int = 0
     admit_order: int = -1     # monotone stamp set at admission (victim pick)
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
 
     @property
     def resume_tokens(self) -> List[int]:
@@ -84,6 +119,9 @@ class Scheduler:
         self.slots: List[Optional[Request]] = [None] * num_slots
         self.admit_log: List[str] = []   # rids in true admission order
         self._admit_stamp = itertools.count()
+        #: requests ``admit_next``/``expire_queued`` moved to TIMEOUT this
+        #: step; the engine drains it for metrics/accounting
+        self.reaped: List[Request] = []
 
     # -- introspection -------------------------------------------------
 
@@ -115,9 +153,37 @@ class Scheduler:
                 f"sequence (raise num_blocks/max_model_len)")
         self.queue.append(req)
 
-    def admit_next(self) -> Optional[Request]:
+    def queued_block_demand(self) -> int:
+        """Prefill pages the queue would claim if admitted right now —
+        the KV-headroom admission signal."""
+        return sum(self.pool.blocks_for_tokens(len(r.resume_tokens))
+                   for r in self.queue)
+
+    def expire_queued(self, now: Optional[float] = None) -> List[Request]:
+        """Shed every queued request past its deadline (any position, not
+        just the head): terminal TIMEOUT, no pages to return (queued
+        requests never own pages). Returns the shed requests and also
+        stages them on ``self.reaped``."""
+        now = time.perf_counter() if now is None else now
+        shed = [r for r in self.queue if r.expired(now)]
+        for req in shed:
+            self.queue.remove(req)
+            self._release(req, RequestState.TIMEOUT, "deadline")
+            self.reaped.append(req)
+        return shed
+
+    def admit_next(self, now: Optional[float] = None) -> Optional[Request]:
         """Admit the queue HEAD if a slot and its prefill pages are free;
-        None otherwise (nothing behind the head is considered — FIFO)."""
+        None otherwise (nothing behind the head is considered — FIFO).
+        Heads already past their deadline are shed (TIMEOUT, staged on
+        ``self.reaped``) rather than admitted — expiry is enforced at the
+        admission gate, so a deadline is honored even if the engine never
+        ran a dedicated expiry sweep."""
+        now = time.perf_counter() if now is None else now
+        while self.queue and self.queue[0].expired(now):
+            req = self.queue.popleft()
+            self._release(req, RequestState.TIMEOUT, "deadline")
+            self.reaped.append(req)
         if not self.queue:
             return None
         slot = self._free_slot()
@@ -151,11 +217,22 @@ class Scheduler:
         return True
 
     def preempt_victim(self, exclude: Request) -> Optional[Request]:
-        """Most-recently-admitted running request other than ``exclude``."""
+        """Lowest-priority running request other than ``exclude``; within a
+        priority, the most recently admitted (graceful degradation sheds
+        cheap/new work first)."""
         candidates = [r for _, r in self.active() if r is not exclude]
         if not candidates:
             return None
-        return max(candidates, key=lambda r: r.admit_order)
+        return max(candidates, key=lambda r: (-r.priority, r.admit_order))
+
+    def displaceable(self, below_priority: int) -> List[Request]:
+        """Queued requests a higher-priority submit may displace, in shed
+        order: strictly lower priority than the newcomer, lowest priority
+        first, newest submission within a tier. THE one definition of the
+        load-shedding policy — admission gates consume this list as a dry
+        run and commit via ``cancel``."""
+        return sorted((r for r in self.queue if r.priority < below_priority),
+                      key=lambda r: (r.priority, -r.submit_time))
 
     def preempt(self, req: Request) -> None:
         """Evict: free pages + slot, requeue at the FRONT carrying progress."""
@@ -168,23 +245,34 @@ class Scheduler:
         req.preemptions += 1
         self.queue.appendleft(req)
 
-    # -- completion ----------------------------------------------------
+    # -- completion (every terminal transition funnels through _release,
+    # so "pages always return to the pool" is enforced in ONE place) ----
 
-    def finish(self, req: Request, reason: str) -> None:
-        self.pool.free(req.blocks, req.rid)
-        self.slots[req.slot] = None
-        req.blocks = []
-        req.slot = None
-        req.state = RequestState.FINISHED
-        req.finish_reason = reason
-        req.finish_time = time.perf_counter()
-
-    def fail(self, req: Request, reason: str) -> None:
+    def _release(self, req: Request, state: RequestState, reason: str) -> None:
+        if req.state is RequestState.QUEUED and req in self.queue:
+            # a terminal request must never sit in the deque: admit_next
+            # would silently resurrect it to RUNNING later (the "in queue"
+            # check covers callers that already popped it themselves)
+            self.queue.remove(req)
         if req.slot is not None:
             self.pool.free(req.blocks, req.rid)
             self.slots[req.slot] = None
             req.blocks = []
             req.slot = None
-        req.state = RequestState.FAILED
+        req.state = state
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
+
+    def finish(self, req: Request, reason: str) -> None:
+        self._release(req, RequestState.FINISHED, reason)
+
+    def fail(self, req: Request, reason: str) -> None:
+        self._release(req, RequestState.FAILED, reason)
+
+    def timeout(self, req: Request, reason: str = "deadline") -> None:
+        self._release(req, RequestState.TIMEOUT, reason)
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> None:
+        """Terminal CANCELLED from ANY live state: queued requests leave
+        the queue, running ones release slot + pages."""
+        self._release(req, RequestState.CANCELLED, reason)

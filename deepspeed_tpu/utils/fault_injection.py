@@ -17,19 +17,36 @@ script. Grammar — comma-separated specs, each ``name[:key=value]*``::
                                              # retry-with-backoff path)
     DS_FAULT=flaky_init:fails=1              # coordinator connect fails once
 
+Serving chaos vocabulary (injection points in ``serving/engine.py``)::
+
+    DS_FAULT=stall:tag=serving_step          # wedge before the step (legacy)
+    DS_FAULT=slow_step:seconds=1             # decode step goes slow INSIDE
+                                             # the watchdog-guarded region
+    DS_FAULT=corrupt_logits:fails=1          # NaN one active slot's logits
+                                             # (the output guard quarantines
+                                             # that request, not the batch)
+    DS_FAULT=flaky_prefill:fails=2           # prefill raises; the request
+                                             # fails, serving continues
+    DS_FAULT=slow_step:p=0.2:seconds=0.1     # probabilistic variant: any
+                                             # spec may carry p=<prob>
+
 Recognized match keys: ``step`` / ``rank`` / ``tag`` (spec fires only when
 the injection point reports a matching value), ``fails`` (bounded faults:
 fire at most N times, then the point behaves normally), ``seconds`` (stall
-duration; default forever), ``phase`` (``crash_during_save``: ``begin`` dies
+duration; default forever), ``p`` (probabilistic faults: fire with
+probability p per otherwise-matching probe, seeded by ``DS_FAULT_SEED`` so
+chaos runs replay), ``phase`` (``crash_during_save``: ``begin`` dies
 before any bytes are written, default ``commit`` dies between the data
 commit and the manifest write — the classic partial save).
 
 Injection points live in the checkpoint save path, the engine step loop,
-and ``init_distributed``; each is a no-op unless a spec matches, so the
-harness costs nothing in production.
+the serving engine's admit/prefill/decode path, and ``init_distributed``;
+each is a no-op unless a spec matches, so the harness costs nothing in
+production.
 """
 
 import os
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
@@ -66,6 +83,9 @@ class FaultSpec:
         fails = self.params.get("fails")
         if fails is not None and self.fired >= int(fails):
             return False
+        p = self.params.get("p")
+        if p is not None and _prob_rng().random() >= float(p):
+            return False
         return True
 
 
@@ -95,6 +115,17 @@ def parse_faults(text: str) -> List[FaultSpec]:
 # DS_FAULT get a fresh parse.
 _cache: Tuple[Optional[str], List[FaultSpec]] = (None, [])
 
+# Probabilistic faults (p=<prob>) draw from one seeded stream so a chaos
+# drill replays exactly under the same DS_FAULT_SEED; reset() reseeds.
+_prob: Optional[random.Random] = None
+
+
+def _prob_rng() -> random.Random:
+    global _prob
+    if _prob is None:
+        _prob = random.Random(int(os.environ.get("DS_FAULT_SEED", "0")))
+    return _prob
+
 
 def _specs() -> List[FaultSpec]:
     global _cache
@@ -117,9 +148,11 @@ def get_fault(name: str, *, step: Optional[int] = None,
 
 
 def reset() -> None:
-    """Forget trigger counts (test isolation)."""
-    global _cache
+    """Forget trigger counts and reseed the probabilistic stream (test
+    isolation)."""
+    global _cache, _prob
     _cache = (None, [])
+    _prob = None
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +185,19 @@ def maybe_stall(name: str, **ctx: Any) -> None:
     deadline = time.time() + seconds
     while time.time() < deadline:
         time.sleep(min(1.0, max(0.0, deadline - time.time())))
+
+
+def maybe_flag(name: str, **ctx: Any) -> Optional[FaultSpec]:
+    """Arm a fault the CALLER realizes (e.g. the serving engine NaN-ing one
+    slot's logits for ``corrupt_logits``): returns the matching spec with
+    its trigger count consumed, or None. The caller owns the actual damage;
+    this just decides whether the drill fires here."""
+    spec = get_fault(name, **ctx)
+    if spec is None:
+        return None
+    spec.fired += 1
+    logger.error(f"DS_FAULT: armed {name} at {ctx}")
+    return spec
 
 
 def maybe_fail(name: str, exc: Type[Exception] = OSError, **ctx: Any) -> None:

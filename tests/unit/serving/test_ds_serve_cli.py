@@ -1,0 +1,48 @@
+"""`bin/ds_serve` input robustness: malformed JSONL lines become per-request
+error records + non-zero exit — never a traceback (and never a checkpoint
+load when nothing valid remains)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_malformed_jsonl_error_records_nonzero_exit(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('not json at all\n'
+                   '{"max_new_tokens": 4}\n'
+                   '{"prompt_ids": "nope"}\n'
+                   '{"prompt_ids": []}\n'
+                   '{"text": "needs a tokenizer"}\n')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--checkpoint", str(tmp_path / "never_loaded"),
+         "--prompts", str(bad), "--cpu"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 2, (r.returncode, r.stderr[-2000:])
+    assert "Traceback" not in r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(recs) == 5 and all(rec["state"] == "error" for rec in recs)
+    assert recs[0]["line"] == 1 and "Expecting value" in recs[0]["error"]
+    assert "prompt_ids or text" in recs[1]["error"]
+    assert "non-empty list" in recs[2]["error"]
+    assert "tokenizer" in recs[4]["error"]
+
+
+def test_demo_cannot_mix_with_prompts(tmp_path):
+    p = tmp_path / "p.jsonl"
+    p.write_text('{"prompt_ids": [1]}\n')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "2", "--prompts", str(p), "--cpu"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 2
+    assert "cannot be combined" in r.stderr

@@ -1,0 +1,277 @@
+"""Overload control on the ServingEngine: deadlines, admission control and
+backpressure, cancellation from every state, brownout, drain, and the
+forget() block-return paths. One shared engine per module (its jitted
+programs are per-instance) — every test leaves it drained and leak-free.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import (RejectedError, RequestState,
+                                             ServingConfig, ServingEngine)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+@pytest.fixture(scope="module")
+def srv(llama_engine):
+    return ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=16, max_model_len=32))
+
+
+@pytest.fixture()
+def clean(srv):
+    """Every test hands the shared engine back drained, admitting, with
+    default overload knobs (runtime-only knobs never reshape the compiled
+    programs, so tests may tweak them freely)."""
+    yield srv
+    srv.resume_admission()
+    srv.set_brownout(None)
+    cfg = srv.config
+    cfg.max_queue_depth = 0
+    cfg.kv_headroom_blocks = None
+    cfg.default_deadline_s = None
+    cfg.brownout_occupancy = None
+    while srv.has_work():
+        srv.step()
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+
+
+def _prompt(rs, srv, n=5):
+    vocab = srv.engine.module.config.vocab_size
+    return rs.randint(1, vocab, n)
+
+
+def test_queued_deadline_times_out_at_admission(clean):
+    srv = clean
+    rs = np.random.RandomState(0)
+    rid = srv.submit(_prompt(rs, srv), max_new_tokens=4, deadline_s=0.005)
+    time.sleep(0.02)
+    srv.step()
+    o = srv.poll(rid)
+    assert o.state == "timeout" and o.finish_reason == "deadline"
+    assert o.tokens == []              # never admitted, nothing generated
+    assert srv.metrics.requests_timeout >= 1
+
+
+def test_running_deadline_terminal_timeout_keeps_partial_tokens(clean):
+    srv = clean
+    rs = np.random.RandomState(1)
+    rid = srv.submit(_prompt(rs, srv), max_new_tokens=25, deadline_s=0.25)
+    deadline = time.perf_counter() + 5.0
+    while not srv.poll(rid).state == "timeout":
+        assert time.perf_counter() < deadline, "deadline never enforced"
+        srv.step()
+    o = srv.poll(rid)
+    assert o.finish_reason == "deadline"
+    assert 0 < len(o.tokens) < 25      # ran for a while, then was cut
+    srv.block_pool.check_consistent()  # pages returned immediately
+
+
+def test_bounded_queue_rejects_and_priority_displaces(clean):
+    srv = clean
+    rs = np.random.RandomState(2)
+    srv.config.max_queue_depth = 2
+    a = srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    b = srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    with pytest.raises(RejectedError) as ei:
+        srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    assert ei.value.reason == "queue_full"
+    assert srv.try_submit(_prompt(rs, srv), max_new_tokens=3) is None
+    assert srv.metrics.requests_rejected >= 2
+    # a higher-priority submit displaces the newest prio-0 queued request
+    hi = srv.submit(_prompt(rs, srv), max_new_tokens=3, priority=1)
+    assert srv.poll(b).state == "cancelled"
+    assert srv._requests[b].finish_reason == "shed_overload"
+    outs = srv.run()
+    assert outs[a].state == "finished" and outs[hi].state == "finished"
+
+
+def test_kv_headroom_admission_gate(clean):
+    srv = clean
+    rs = np.random.RandomState(3)
+    # demand = used + queued prefills + newcomer must leave headroom free
+    srv.config.kv_headroom_blocks = srv.block_pool.num_blocks
+    with pytest.raises(RejectedError) as ei:
+        srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    assert ei.value.reason == "kv_headroom"
+    srv.config.kv_headroom_blocks = None
+    rid = srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    assert srv.run()[rid].state == "finished"
+
+
+def test_kv_headroom_displaces_lower_priority_queued(clean):
+    """The headroom gate honors priority too: a high-priority submit sheds
+    queued lower-priority demand until it fits, instead of being
+    rejected while displaceable work sits in the queue."""
+    srv = clean
+    rs = np.random.RandomState(9)
+    lo = [srv.submit(_prompt(rs, srv, 8), max_new_tokens=3)
+          for _ in range(3)]          # 1 block of queued demand each
+    # budget leaves room for ~3 one-block prefills only
+    srv.config.kv_headroom_blocks = srv.block_pool.num_blocks - 3
+    with pytest.raises(RejectedError):       # equal priority: no victim
+        srv.submit(_prompt(rs, srv, 8), max_new_tokens=3)
+    hi = srv.submit(_prompt(rs, srv, 8), max_new_tokens=3, priority=2)
+    shed = [r for r in lo if srv.poll(r).state == "cancelled"]
+    assert shed and all(
+        srv._requests[r].finish_reason == "shed_overload" for r in shed)
+    srv.config.kv_headroom_blocks = None
+    outs = srv.run()
+    assert outs[hi].state == "finished"
+
+
+def test_cancel_every_state(clean):
+    srv = clean
+    rs = np.random.RandomState(4)
+    # QUEUED: 2 slots busy, third stays queued
+    busy = [srv.submit(_prompt(rs, srv), max_new_tokens=12)
+            for _ in range(2)]
+    queued = srv.submit(_prompt(rs, srv), max_new_tokens=4)
+    srv.step()
+    assert srv.poll(queued).state == "queued"
+    assert srv.cancel(queued)
+    assert srv.poll(queued).state == "cancelled"
+    # RUNNING: slot + pages released the same call
+    assert srv.poll(busy[0]).state == "running"
+    used_before = srv.block_pool.used_count
+    assert srv.cancel(busy[0])
+    assert srv.poll(busy[0]).state == "cancelled"
+    assert srv.block_pool.used_count < used_before
+    # terminal: cancel is a no-op that reports False, outcome stands
+    outs = srv.run()
+    assert outs[busy[1]].state == "finished"
+    assert not srv.cancel(busy[1])
+    assert srv.poll(busy[1]).state == "finished"
+    assert srv.metrics.requests_cancelled >= 2
+
+
+def test_forget_queued_preempted_and_running_return_blocks(clean):
+    """The forget() failure paths: a live request (queued, preempted-
+    requeued, or mid-decode) is cancelled on forget and every page goes
+    back to the pool."""
+    srv = clean
+    rs = np.random.RandomState(5)
+    # running (owns pages)
+    running = srv.submit(_prompt(rs, srv), max_new_tokens=12)
+    # queued behind it
+    srv.submit(_prompt(rs, srv), max_new_tokens=12)  # occupies slot 2
+    queued = srv.submit(_prompt(rs, srv), max_new_tokens=4)
+    srv.step()
+    assert srv.poll(queued).state == "queued"
+    out = srv.forget(queued)
+    assert out.state == "cancelled"
+    with pytest.raises(KeyError):
+        srv.poll(queued)
+    # preempted-requeued: preempt the running request, then forget it
+    req = srv._requests[running]
+    assert req.state is RequestState.RUNNING
+    srv.sched.preempt(req)
+    srv._clear_slot_arrays(req)
+    assert req.state is RequestState.QUEUED and req.preemptions == 1
+    assert srv.forget(running).state == "cancelled"
+    # running: forget cancels and frees mid-decode
+    mid = srv.submit(_prompt(rs, srv), max_new_tokens=12)
+    srv.step()
+    assert srv.poll(mid).state == "running"
+    assert srv.forget(mid).state == "cancelled"
+    srv.run()
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+
+
+def test_brownout_caps_admission_budget(clean):
+    srv = clean
+    rs = np.random.RandomState(6)
+    srv.set_brownout(True)
+    cap = srv.config.brownout_max_new_tokens
+    rid = srv.submit(_prompt(rs, srv), max_new_tokens=cap + 10)
+    outs = srv.run()
+    assert outs[rid].state == "finished"
+    assert len(outs[rid].tokens) == cap
+    assert srv.metrics.brownout_admissions >= 1
+    assert srv.metrics.brownout_active
+    srv.set_brownout(None)
+    # automatic engagement: occupancy threshold 0 -> engaged immediately
+    srv.config.brownout_occupancy = 0.0
+    assert srv.brownout
+    srv.config.brownout_occupancy = None
+    assert not srv.brownout
+
+
+def test_drain_finishes_residents_sheds_queue_blocks_admission(clean):
+    srv = clean
+    rs = np.random.RandomState(7)
+    resident = srv.submit(_prompt(rs, srv), max_new_tokens=6)
+    srv.step()
+    srv.submit(_prompt(rs, srv), max_new_tokens=6)  # second resident
+    queued = srv.submit(_prompt(rs, srv), max_new_tokens=6)
+    srv.step()
+    late = srv.submit(_prompt(rs, srv), max_new_tokens=6)  # still queued
+    outs = srv.drain()
+    assert outs[resident].state == "finished"     # residents finish
+    assert outs[late].state == "cancelled"        # queue is shed
+    assert srv._requests[late].finish_reason == "drained"
+    with pytest.raises(RejectedError) as ei:      # admission closed
+        srv.submit(_prompt(rs, srv), max_new_tokens=2)
+    assert ei.value.reason == "draining"
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+    srv.resume_admission()                        # reopen
+    rid = srv.submit(_prompt(rs, srv), max_new_tokens=3)
+    assert srv.run()[rid].state == "finished"
+    del outs[queued]  # queued at drain time: shed unless a slot freed first
+
+
+def test_overload_counters_flow_through_monitor(clean):
+    """The observability half of the contract: shed/timeout/cancel/reject
+    counters surface as standard monitor events."""
+    srv = clean
+    rs = np.random.RandomState(8)
+
+    class FakeMonitor:
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, evs):
+            self.events.extend(evs)
+
+    mon = FakeMonitor()
+    srv.monitor = mon
+    try:
+        srv.config.max_queue_depth = 2
+        srv.submit(_prompt(rs, srv), max_new_tokens=3)
+        queued = srv.submit(_prompt(rs, srv), max_new_tokens=3)
+        assert srv.try_submit(_prompt(rs, srv), max_new_tokens=3) is None
+        srv.cancel(queued)
+        srv.run()
+    finally:
+        srv.monitor = None
+        srv.config.max_queue_depth = 0
+    tags = {t for t, _, _ in mon.events}
+    for want in ("serving/requests_rejected", "serving/requests_cancelled",
+                 "serving/requests_timeout", "serving/requests_shed",
+                 "serving/watchdog_trips", "serving/logit_quarantines",
+                 "serving/brownout_active"):
+        assert want in tags, f"missing {want} in {sorted(tags)}"
+    by_tag = {t: v for t, v, _ in mon.events}
+    assert by_tag["serving/requests_rejected"] >= 1
+    assert by_tag["serving/requests_cancelled"] >= 1

@@ -2,6 +2,8 @@
 FIFO, preemption requeues at the front with progress intact, and random
 admit/grow/finish/preempt cycles never leak or double-free a page."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -89,6 +91,109 @@ def test_decode_headroom_grows_one_page_at_boundary():
     pool.check_consistent()
 
 
+def test_fail_mid_decode_returns_all_blocks():
+    """Scheduler.fail on a RUNNING request that grew extra decode pages
+    must return every page — the serving engine calls exactly this when a
+    step watchdog trips or logits go NaN mid-decode."""
+    pool = BlockPool(8, 4)
+    sched = Scheduler(num_slots=2, pool=pool, max_blocks_per_seq=8)
+    r = _mk(4, max_new=16)
+    sched.submit(r)
+    _admit_and_prefill(sched)
+    for _ in range(6):         # decode growth across page boundaries
+        r.seq_len += 1
+        assert sched.ensure_decode_headroom(r)
+    assert len(r.blocks) > 1   # really grew beyond the prefill page
+    sched.fail(r, "step_watchdog")
+    assert r.state is RequestState.FAILED and r.blocks == [] and r.slot is None
+    pool.check_consistent()
+    assert pool.used_count == 0
+
+
+def test_cancel_and_timeout_release_from_any_live_state():
+    pool = BlockPool(8, 4)
+    sched = Scheduler(num_slots=1, pool=pool, max_blocks_per_seq=8)
+    queued, running = _mk(4), _mk(4)
+    sched.submit(running)
+    sched.submit(queued)
+    _admit_and_prefill(sched)
+    assert running.state is RequestState.RUNNING
+    assert queued.state is RequestState.QUEUED
+    sched.cancel(queued)            # queued: leaves the queue, no pages
+    assert queued.state is RequestState.CANCELLED and not sched.queue
+    sched.timeout(running)          # running: slot + pages released
+    assert running.state is RequestState.TIMEOUT and running.slot is None
+    pool.check_consistent()
+    assert pool.used_count == 0
+    assert all(r.done for r in (queued, running))
+
+
+def test_terminal_queued_request_never_resurrected():
+    """timeout()/fail()/cancel() on a QUEUED request must also remove it
+    from the deque — otherwise admit_next would resurrect a terminal
+    request to RUNNING and allocate pages for a dead rid."""
+    pool = BlockPool(8, 4)
+    sched = Scheduler(num_slots=2, pool=pool, max_blocks_per_seq=8)
+    for op in ("timeout", "fail", "cancel"):
+        r = _mk(4)
+        sched.submit(r)
+        getattr(sched, op)(r, "chaos") if op == "fail" else \
+            getattr(sched, op)(r)
+        assert r.done and r not in sched.queue
+        assert sched.admit_next() is None   # nothing to resurrect
+        pool.check_consistent()
+        assert pool.used_count == 0
+
+
+def test_admit_next_sheds_expired_head():
+    """Deadline expiry is enforced at the admission gate itself: an expired
+    head is reaped (terminal TIMEOUT, staged on sched.reaped), and the
+    request behind it admits in its place."""
+    pool = BlockPool(8, 4)
+    sched = Scheduler(num_slots=1, pool=pool, max_blocks_per_seq=8)
+    expired = _mk(4)
+    expired.deadline = time.perf_counter() - 1.0
+    live = _mk(4)
+    sched.submit(expired)
+    sched.submit(live)
+    got = sched.admit_next()
+    assert got is live
+    assert expired.state is RequestState.TIMEOUT
+    assert sched.reaped == [expired]
+    pool.check_consistent()
+
+
+def test_expire_queued_sheds_any_position():
+    pool = BlockPool(8, 4)
+    sched = Scheduler(num_slots=1, pool=pool, max_blocks_per_seq=8)
+    head, mid, tail = _mk(4), _mk(4), _mk(4)
+    mid.deadline = time.perf_counter() - 1.0   # expired, NOT the head
+    for r in (head, mid, tail):
+        sched.submit(r)
+    shed = sched.expire_queued()
+    assert shed == [mid] and mid.state is RequestState.TIMEOUT
+    assert list(sched.queue) == [head, tail]
+
+
+def test_preempt_victim_takes_lowest_priority_then_newest():
+    pool = BlockPool(12, 4)
+    sched = Scheduler(num_slots=3, pool=pool, max_blocks_per_seq=4)
+    hi = _mk(2, priority=5)
+    lo_old = _mk(2, priority=0)
+    lo_new = _mk(2, priority=0)
+    for r in (hi, lo_old, lo_new):
+        sched.submit(r)
+    _admit_and_prefill(sched)
+    # lowest priority first; among equals the most recently admitted
+    assert sched.preempt_victim(exclude=hi) is lo_new
+    sched.preempt(lo_new)
+    assert sched.preempt_victim(exclude=hi) is lo_old
+    sched.preempt(lo_old)
+    # only the high-priority peer left: it is never a victim of itself
+    assert sched.preempt_victim(exclude=hi) is None
+    pool.check_consistent()
+
+
 def test_property_random_lifecycle_never_leaks():
     """Random admit/grow/finish/preempt storm: pool accounting stays exact
     and admission order always equals submission order."""
@@ -115,10 +220,22 @@ def test_property_random_lifecycle_never_leaks():
                     victim.seq_len -= 1
         elif active:
             r = active[int(rs.randint(len(active)))]
-            if rs.rand() < 0.5:
+            roll2 = rs.rand()
+            if roll2 < 0.4:
                 sched.finish(r, "length")
-            else:
+            elif roll2 < 0.6:
                 sched.preempt(r)
+            elif roll2 < 0.7:
+                sched.fail(r, "chaos")
+            elif roll2 < 0.85:
+                sched.timeout(r)
+            else:
+                sched.cancel(r)
+        elif sched.queue and rs.rand() < 0.15:
+            # shed from the queue too: cancel/timeout must release cleanly
+            # from QUEUED (including preempted-requeued) state
+            q = sched.queue[int(rs.randint(len(sched.queue)))]
+            (sched.cancel if rs.rand() < 0.5 else sched.timeout)(q)
         pool.check_consistent()
         owned = [b for _, r in sched.active() for b in r.blocks]
         assert len(owned) == len(set(owned)) == pool.used_count

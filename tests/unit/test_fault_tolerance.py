@@ -92,6 +92,44 @@ class TestGrammar:
         assert FI.get_fault("stall") is None
         FI.maybe_crash("crash")  # must be a no-op, not an exit
 
+    def test_probabilistic_spec_seeded_and_replayable(self, monkeypatch):
+        monkeypatch.setenv(FI.ENV_VAR, "slow_step:p=0.5")
+        monkeypatch.setenv("DS_FAULT_SEED", "7")
+        FI.reset()
+        draws1 = [FI.get_fault("slow_step") is not None for _ in range(64)]
+        assert any(draws1) and not all(draws1)  # really probabilistic
+        FI.reset()  # same seed -> identical replay (chaos drills replay)
+        draws2 = [FI.get_fault("slow_step") is not None for _ in range(64)]
+        assert draws1 == draws2
+        monkeypatch.setenv("DS_FAULT_SEED", "8")
+        FI.reset()
+        draws3 = [FI.get_fault("slow_step") is not None for _ in range(64)]
+        assert draws1 != draws3
+
+    def test_maybe_flag_consumes_trigger(self, monkeypatch):
+        monkeypatch.setenv(FI.ENV_VAR, "corrupt_logits:fails=1")
+        FI.reset()
+        assert FI.maybe_flag("corrupt_logits") is not None
+        assert FI.maybe_flag("corrupt_logits") is None  # bound spent
+
+
+def test_ds_report_prints_active_fault_spec(monkeypatch, capsys):
+    """Chaos runs are self-describing: ds_report names every armed fault."""
+    from deepspeed_tpu.env_report import fault_report
+
+    monkeypatch.delenv(FI.ENV_VAR, raising=False)
+    fault_report()
+    assert "DS_FAULT): none" in capsys.readouterr().out
+    monkeypatch.setenv(FI.ENV_VAR, "slow_step:p=0.2:seconds=0.1,"
+                                   "corrupt_logits:fails=1")
+    fault_report()
+    out = capsys.readouterr().out
+    assert "armed: slow_step (p=0.2, seconds=0.1)" in out
+    assert "armed: corrupt_logits (fails=1)" in out
+    monkeypatch.setenv(FI.ENV_VAR, "stall:rank")  # malformed
+    fault_report()
+    assert "MALFORMED" in capsys.readouterr().out
+
 
 def test_retry_with_backoff_recovers_then_gives_up():
     calls = {"n": 0}

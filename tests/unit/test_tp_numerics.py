@@ -1,0 +1,101 @@
+"""TP numerics: pins the r7 investigation of the mp_size=4 logit
+divergence (ROADMAP open item).
+
+Findings (fp32 tiny Llama, virtual CPU mesh):
+
+- The old "reduction-order / RMSNorm accumulation" hypothesis is
+  REFUTED: whenever ``mp_size`` divides ``num_key_value_heads``, TP
+  logits match single-device to ~1e-6 — that is the true size of psum
+  reduction-order noise, and RMSNorm already accumulates in fp32.
+- The real cause is GQA head splitting: ``mp_size=4`` over
+  ``num_key_value_heads=2`` gives each shard HALF a kv head; XLA's SPMD
+  partitioner mis-partitions the ``repeat_kv`` broadcast-reshape over the
+  unevenly-sharded head axis and the forward silently computes wrong
+  logits (max |dlogit| ~2.4, ~65% of logit scale; greedy tokens flip).
+
+These tests pin both sides so any movement is visible: a partitioner or
+model fix makes the divergence test FAIL (tight it up then!), a
+regression in the divisible path fails the parity tests.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.parallel import build_mesh
+
+pytestmark = pytest.mark.slow
+
+#: reduction-order noise bound for divisible TP on the fp32 tiny model
+#: (measured ~1.5e-6; 1e-4 leaves margin for XLA version drift)
+DIVISIBLE_TP_TOL = 1e-4
+#: pinned band of the known mp=4/Hkv=2 divergence (measured max ~2.38):
+#: above the band = got worse, below = the partitioner/model was fixed —
+#: either way, look
+KNOWN_DIVERGENCE_LO, KNOWN_DIVERGENCE_HI = 0.05, 4.0
+
+
+def _logits(cfg, params, prompt, **init_kw):
+    from deepspeed_tpu.parallel import topology
+
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+    eng = ds.init_inference(LlamaForCausalLM(cfg), params=params,
+                            dtype="fp32", **init_kw)
+    out = np.asarray(eng.forward(jnp.asarray(prompt)))
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+    return out
+
+
+def _setup(**cfg_over):
+    cfg = LlamaConfig.tiny(remat=False, **cfg_over)
+    params = jax.jit(LlamaForCausalLM(cfg).init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = np.random.RandomState(23).randint(1, cfg.vocab_size, 8)[None]
+    return cfg, params, prompt
+
+
+def test_tp_divisible_kv_heads_matches_single_device():
+    """mp=2 divides Hkv=2: TP-vs-single difference is pure reduction
+    order, ~1e-6 — NOT the ~1.35 the old open item attributed to it."""
+    cfg, params, prompt = _setup()
+    single = _logits(cfg, params, prompt)
+    tp2 = _logits(cfg, params, prompt, mp_size=2,
+                  mesh=build_mesh(data=4, model=2))
+    assert np.abs(single - tp2).max() < DIVISIBLE_TP_TOL
+    assert (single.argmax(-1) == tp2.argmax(-1)).all()  # greedy identical
+
+
+def test_tp4_mha_matches_single_device():
+    """mp=4 with Hkv=4 (no GQA split): also exact to reduction order —
+    the divergence is NOT a property of mp=4 itself."""
+    cfg, params, prompt = _setup(num_key_value_heads=4)
+    single = _logits(cfg, params, prompt)
+    tp4 = _logits(cfg, params, prompt, mp_size=4,
+                  mesh=build_mesh(data=2, model=4))
+    assert np.abs(single - tp4).max() < DIVISIBLE_TP_TOL
+    assert (single.argmax(-1) == tp4.argmax(-1)).all()
+
+
+def test_tp4_gqa_head_split_divergence_pinned():
+    """mp=4 over Hkv=2 splits kv heads across shards: the SPMD-partitioned
+    repeat_kv mis-computes and logits diverge. Pin the current bound: a
+    FAIL below the band means the stack got fixed (tighten to
+    DIVISIBLE_TP_TOL and drop the init-time warning); above means it got
+    even worse."""
+    cfg, params, prompt = _setup()  # tiny default: Hkv=2
+    assert cfg.num_key_value_heads == 2
+    single = _logits(cfg, params, prompt)
+    tp4 = _logits(cfg, params, prompt, mp_size=4,
+                  mesh=build_mesh(data=2, model=4))
+    d = np.abs(single - tp4).max()
+    assert KNOWN_DIVERGENCE_LO < d < KNOWN_DIVERGENCE_HI, (
+        f"mp=4/Hkv=2 divergence moved out of its pinned band: {d:.4g} "
+        f"(band {KNOWN_DIVERGENCE_LO}..{KNOWN_DIVERGENCE_HI}); if it "
+        f"shrank below the band the partitioner bug is fixed — tighten "
+        f"this test and remove the engine warning")
