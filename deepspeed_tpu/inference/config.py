@@ -72,6 +72,13 @@ class DeepSpeedInferenceConfig:
     dequant_per_step: bool = False
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
+    #: escape hatch for the TP/GQA guard: ``mp_size > num_key_value_heads``
+    #: splits single GQA kv heads across shards and XLA's SPMD partitioner
+    #: mis-partitions the repeat_kv broadcast-reshape — the forward
+    #: silently computes WRONG logits (r7 TP-numerics investigation, max
+    #: |dlogit| ~2.4 on the tiny model at mp=4/Hkv=2). init_inference
+    #: REJECTS such configs unless this is True (debugging/repro only).
+    allow_unsafe_tp: bool = False
     #: bucket generate() shapes to powers of two (prompts left-padded, new
     #: tokens over-generated and trimmed) so varied request shapes reuse
     #: cached executables instead of recompiling per exact shape

@@ -86,11 +86,27 @@ class InferenceEngine:
         # across shards; XLA's SPMD partitioner then mis-partitions the
         # repeat_kv broadcast-reshape and the forward silently computes
         # WRONG logits (r7 TP-numerics investigation: max |dlogit| ~2.4 on
-        # the tiny model at mp=4/Hkv=2, vs ~1e-6 whenever mp | Hkv). Warn
-        # loudly until kv-head replication lands.
+        # the tiny model at mp=4/Hkv=2, vs ~1e-6 whenever mp | Hkv — that
+        # is PROVEN wrong, not merely suspect, so it is a hard reject).
+        # Non-divisible configs that still fit under the kv-head count are
+        # untested territory rather than a proven failure: warn loudly.
         n_kv = getattr(getattr(module, "config", None),
                        "num_key_value_heads", None)
-        if n_kv is not None and self.mp_world_size > 1 and \
+        if n_kv is not None and self.mp_world_size > n_kv:
+            msg = (f"mp_size={self.mp_world_size} > num_key_value_heads="
+                   f"{n_kv}: each TP shard would own a FRACTION of a GQA "
+                   f"kv head, and XLA's SPMD partitioner is proven to "
+                   f"mis-partition the repeat_kv broadcast-reshape there "
+                   f"(silently wrong logits; see ROADMAP: TP numerics). "
+                   f"Use mp_size <= {n_kv}, or replicate kv heads across "
+                   f"TP shards (Megatron-style kv-head duplication in the "
+                   f"partition rules + attention core) before raising TP. "
+                   f"Pass allow_unsafe_tp=True only to reproduce the "
+                   f"known-wrong numerics.")
+            if not getattr(config, "allow_unsafe_tp", False):
+                raise ValueError(msg)
+            log_dist(f"WARNING (allow_unsafe_tp): {msg}", ranks=[0])
+        elif n_kv is not None and self.mp_world_size > 1 and \
                 n_kv % self.mp_world_size != 0:
             log_dist(
                 f"WARNING: mp_size={self.mp_world_size} does not divide "

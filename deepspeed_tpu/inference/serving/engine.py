@@ -66,7 +66,7 @@ from ...models.layers import paged_cache_index
 from ...utils import fault_injection
 from ...utils.logging import log_dist
 from ..engine import InferenceEngine, _sample_logits, next_pow2
-from .block_pool import BlockPool, BlockPoolError
+from .block_pool import BlockPool, BlockPoolError, chain_hash
 from .metrics import ServingMetrics
 from .scheduler import RejectedError, Request, RequestState, Scheduler
 
@@ -96,8 +96,29 @@ class ServingConfig:
     top_p: float = 1.0
     seed: int = 0
     #: smallest prefill bucket (prompt lengths pad up to powers of two from
-    #: here; each bucket compiles once)
+    #: here; each bucket compiles once). Only the LEGACY monolithic prefill
+    #: uses buckets; chunked prefill has one fixed-shape program.
     prefill_bucket_min: int = 8
+    # -- prefix caching + chunked prefill ------------------------------
+    #: content-addressed KV reuse: full pages are indexed by a hash chained
+    #: over the token prefix; admission matches each prompt's longest
+    #: cached prefix, reuses those pages (copy-on-write on divergence) and
+    #: prefills only the suffix. Unreferenced pages are kept warm and
+    #: evicted LRU instead of blanked. Implies chunked prefill (the
+    #: from-empty monolithic prefill cannot attend a cached prefix).
+    prefix_cache: bool = False
+    #: chunked prefill: compiled chunk length in tokens (0 = legacy
+    #: monolithic bucketed prefill). ONE resident program serves every
+    #: chunk — offsets, block tables and cached-prefix lengths ride as
+    #: data — so long prompts stop monopolizing the step loop.
+    #: With prefix_cache on and this 0, the engine derives 4 * block_size
+    #: (the config object itself is never mutated).
+    prefill_chunk_tokens: int = 0
+    #: per-step prefill token budget of the MIXED step: at most this many
+    #: prompt tokens run per step, so resident decoders keep stepping
+    #: every iteration (no prefill head-of-line blocking). 0 = one chunk's
+    #: worth per step.
+    prefill_token_budget: int = 0
     #: write serving counters to the monitor every N steps (0 = never)
     monitor_every: int = 1
     # -- overload control / resilience ---------------------------------
@@ -157,10 +178,28 @@ class ServingEngine:
         if cfg.max_model_len % cfg.block_size:
             raise ValueError("max_model_len must be a multiple of block_size")
 
+        if cfg.prefill_chunk_tokens < 0 or cfg.prefill_token_budget < 0:
+            # a negative budget would be truthy and silently disable
+            # chunking: admitted requests would sit 'prefilling' forever
+            # and run() would never return — reject at construction like
+            # the other knobs
+            raise ValueError(
+                "prefill_chunk_tokens and prefill_token_budget must be "
+                ">= 0 (0 = default)")
+        # chunk length of the resident chunked-prefill program (0 = legacy
+        # monolithic bucketed prefill) and the mixed step's per-step
+        # prefill token budget — derived, never written back into the
+        # caller's (possibly shared) config object
+        chunk = cfg.prefill_chunk_tokens
+        if cfg.prefix_cache and chunk <= 0:
+            chunk = 4 * cfg.block_size
+        self._chunk = min(chunk, cfg.max_model_len) if chunk > 0 else 0
+        self._chunk_budget = cfg.prefill_token_budget or self._chunk
+
         self.nb_max = cfg.max_model_len // cfg.block_size
         self.block_pool = BlockPool(cfg.num_blocks, cfg.block_size)
         self.sched = Scheduler(cfg.max_batch_size, self.block_pool,
-                               self.nb_max)
+                               self.nb_max, prefix_cache=cfg.prefix_cache)
         self.metrics = ServingMetrics(blocks_total=cfg.num_blocks)
 
         kv_dtype = jnp.int8 if engine.config.kv_cache_int8 \
@@ -187,16 +226,20 @@ class ServingEngine:
         self._brownout_forced: Optional[bool] = None
         #: trace-time counters — a retrace IS a recompile, so these count
         #: XLA compiles of each program kind
-        self.compile_counts = {"decode": 0, "prefill": 0}
-        #: first decode call carries the XLA compile and is never
-        #: watchdog-judged (heartbeat.py's first-beat rule)
+        self.compile_counts = {"decode": 0, "prefill": 0,
+                               "chunked_prefill": 0}
+        #: first decode / chunked-prefill call carries the XLA compile and
+        #: is never watchdog-judged (heartbeat.py's first-beat rule)
         self._decode_warm = False
+        self._chunked_warm = False
         #: the one abandoned watchdog thread, if still wedged in device
         #: compute — bounds thread growth to 1 under a persistent hang
         self._wedged: Optional[threading.Thread] = None
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
+        self._chunked_prefill_fn = None
         self._defrag_fn = None
+        self._copy_blocks_fn = None
         # donation lets XLA update the pool in place on TPU; CPU would only
         # warn that donation is unimplemented. With the step watchdog armed
         # donation stays OFF even on TPU: an abandoned (timed-out) step must
@@ -261,17 +304,37 @@ class ServingEngine:
         # destroy queued work.
         victims: List[Request] = []
         displaceable = self.sched.displaceable(priority)
+        # hash the newcomer's full blocks ONCE: the headroom gate and the
+        # Request both consume these keys (scheduler.submit skips
+        # rehashing when they are already set)
+        prompt_hashes = self.block_pool.prefix_block_hashes(prompt) \
+            if cfg.prefix_cache else None
         if cfg.kv_headroom_blocks is not None:
             budget = self.block_pool.num_blocks - cfg.kv_headroom_blocks
-            demand = (self.block_pool.used_count
-                      + self.sched.queued_block_demand()
-                      + self.block_pool.blocks_for_tokens(len(prompt)))
-            for v in displaceable:
+            # every request is charged the pages its admission takes OUT
+            # of the allocatable pool: uncached suffix + cached
+            # (refcount-0) matched pages it would pin, deduplicated across
+            # the whole scan (a page N sharers match pins once) —
+            # already-referenced matches are in used_count and charged to
+            # nobody twice. Each shed victim RE-RUNS the scan without it
+            # instead of subtracting its charge: a shared pin charged to
+            # the victim may still be pinned by a surviving sharer, and a
+            # plain subtraction would credit it anyway (silently violating
+            # the headroom guarantee). Sheds are rare; the scan is cheap.
+            it = iter(displaceable)
+            while True:
+                charges, newcomer = self.sched.admission_charges(
+                    newcomer_len=len(prompt),
+                    newcomer_hashes=prompt_hashes,
+                    exclude={v.rid for v in victims})
+                demand = (self.block_pool.used_count
+                          + sum(charges.values()) + newcomer)
                 if demand <= budget:
                     break
+                v = next(it, None)
+                if v is None:
+                    break
                 victims.append(v)
-                demand -= self.block_pool.blocks_for_tokens(
-                    len(v.resume_tokens))
             if demand > budget:
                 self.metrics.requests_rejected += 1
                 raise RejectedError(
@@ -297,7 +360,8 @@ class ServingEngine:
             else time.perf_counter() + float(deadline_s)
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id, priority=priority,
-                      deadline=deadline)
+                      deadline=deadline,
+                      block_hashes=prompt_hashes or [])
         if not self.sched.has_work():
             # traffic resuming after a drain (or first ever): re-anchor the
             # throughput window so tokens/sec reflects the current serving
@@ -423,6 +487,14 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.sched.has_work()
 
+    @property
+    def prefill_chunk_tokens(self) -> int:
+        """EFFECTIVE chunk length of the resident chunked-prefill program
+        (0 = legacy monolithic prefill). May differ from the config field:
+        with ``prefix_cache`` on and the field 0, the engine derives
+        ``4 * block_size`` without mutating the caller's config."""
+        return self._chunk
+
     # ------------------------------------------------------------------
     # one scheduler step
     # ------------------------------------------------------------------
@@ -467,9 +539,10 @@ class ServingEngine:
                 return
             self._wedged = None
 
-        # 2. FIFO admission + prefill (interleaved with the running batch:
-        # admitted requests join this very step's decode); brownout caps
-        # each admission's remaining token budget
+        # 2. FIFO admission (interleaved with the running batch: admitted
+        # requests join this very step's decode, or — chunked — start
+        # consuming the step's prefill token budget); brownout caps each
+        # admission's remaining token budget
         brownout = self.brownout
         while True:
             req = self.sched.admit_next()
@@ -480,25 +553,35 @@ class ServingEngine:
                 if capped < req.max_new_tokens:
                     req.max_new_tokens = capped
                     self.metrics.brownout_admissions += 1
+            if req.prefix_len:
+                # prefix-cache hit: these tokens are SERVED without being
+                # recomputed (their pages were acquired, not refilled)
+                self.metrics.prefix_hits += 1
+                self.metrics.cached_prefill_tokens += req.prefix_len
+                self.metrics.prefill_tokens += req.prefix_len
+            if self._chunk:
+                continue  # prefill runs below, under the step token budget
             try:
                 self._prefill(req)
             except BlockPoolError:
                 raise  # accounting invariant broken — never swallow
             except Exception as e:
-                # a failing prefill (flaky_prefill chaos, OOM on one
-                # pathological prompt, ...) fails ITS request; the engine
-                # keeps serving everyone else
-                log_dist(f"serving: prefill failed for {req.rid}: "
-                         f"{type(e).__name__}: {e}", ranks=[0])
-                slot = req.slot
-                self.sched.fail(req, f"prefill_error:{type(e).__name__}")
-                self._clear_slot_arrays(slot)
-                self.metrics.requests_failed += 1
+                self._fail_prefill(req, e)
         self._account_reaped()
 
+        # 2b. the prefill half of the MIXED step: at most
+        # ``prefill_token_budget`` prompt tokens run through the resident
+        # chunked-prefill program, round-robin across prefilling residents,
+        # so the decode below still fires every iteration — a long prompt
+        # can no longer head-of-line-block resident decoders
+        if self._chunk:
+            self._run_prefill_chunks()
+
         # 3. page growth for this step's appends, preempting when dry
+        # (mid-prefill residents own every prompt page already and do not
+        # decode this step — nothing to grow)
         for _, req in list(self.sched.active()):
-            if req.state is not RequestState.RUNNING:
+            if req.state is not RequestState.RUNNING or req.prefilling:
                 continue  # preempted below while growing an earlier slot
             while not self.sched.ensure_decode_headroom(req):
                 victim = self.sched.preempt_victim(exclude=req)
@@ -512,13 +595,23 @@ class ServingEngine:
                     break
                 self._preempt(victim)
             else:
+                # this step appends at seq_len: never into a page other
+                # sequences still reference — copy-on-write first
+                self._ensure_exclusive(req, req.seq_len // self.block_pool.
+                                       block_size)
                 self._write_table_row(req)  # growth may have added a page
                 continue
             break
 
         # 4. the single ragged decode step over all slots, watchdog-bounded
         active = [(s, r) for s, r in self.sched.active()
-                  if r.state is RequestState.RUNNING]
+                  if r.state is RequestState.RUNNING and not r.prefilling]
+        if active and self._wedged is not None and self._wedged.is_alive():
+            # a prefill chunk tripped the watchdog THIS step: nothing else
+            # may touch the backend until the abandoned call clears (the
+            # step-top gate only covers trips from earlier steps)
+            self.metrics.watchdog_skips += 1
+            active = []
         if active:
             if self._decode_fn is None:
                 self._decode_fn = self._build_decode()
@@ -588,6 +681,10 @@ class ServingEngine:
                         continue
                     req.seq_len += 1
                     self._seq_lens[slot] = req.seq_len
+                    # a generated token may have just FILLED a page —
+                    # content-index it so identical continuations
+                    # (multi-turn replays) can reuse it
+                    self._commit_full_blocks(req)
                     self._harvest(req, int(toks[slot]))
 
         # 5. bookkeeping
@@ -603,6 +700,12 @@ class ServingEngine:
         m.queue_depth = self.sched.queue_depth
         m.active_seqs = len(self.sched.active())
         m.blocks_used = self.block_pool.used_count
+        m.blocks_cached = self.block_pool.cached_count
+        m.prefix_evictions = self.block_pool.evictions
+        prefilling = [r for _, r in self.sched.active() if r.prefilling]
+        m.chunked_prefill_waiting = len(prefilling)
+        m.chunked_prefill_queue_age_s = 0.0 if not prefilling else \
+            time.perf_counter() - min(r.submit_time for r in prefilling)
         m.brownout_active = brownout
         if self.monitor is not None and self.config.monitor_every and \
                 self._step_no % self.config.monitor_every == 0:
@@ -633,7 +736,11 @@ class ServingEngine:
             self.pool = self._defrag_fn(self.pool, jnp.asarray(src, jnp.int32))
         for _, req in self.sched.active():
             req.blocks = [mapping[b] for b in req.blocks]
-            self._write_table_row(req)
+            if not req.prefilling:
+                # mid-prefill residents keep a SENTINEL decode row until
+                # their last chunk lands (writing it early would let the
+                # decode step append garbage into their pages)
+                self._write_table_row(req)
         return moved
 
     # ------------------------------------------------------------------
@@ -697,11 +804,24 @@ class ServingEngine:
         self._seq_lens[slot] = 0
         self._last_tok[slot] = 0
 
+    def _fail_prefill(self, req: Request, e: Exception) -> None:
+        """A failing prefill (flaky_prefill chaos, OOM on one pathological
+        prompt, ...) fails ITS request; the engine keeps serving everyone
+        else."""
+        log_dist(f"serving: prefill failed for {req.rid}: "
+                 f"{type(e).__name__}: {e}", ranks=[0])
+        slot = req.slot
+        self.sched.fail(req, f"prefill_error:{type(e).__name__}")
+        self._clear_slot_arrays(slot)
+        self.metrics.requests_failed += 1
+
     def _prefill(self, req: Request) -> None:
         """Run the admitted request's (resume-)prompt through the bucketed
         prefill program: appends its KV into its pages, samples token one.
         NaN/Inf logits quarantine the request (terminal FAILED, pages
-        returned) instead of poisoning its stream."""
+        returned) instead of poisoning its stream. LEGACY (monolithic)
+        path — requires a from-empty sequence, so it never runs when the
+        prefix cache may hand the request a cached prefix."""
         # chaos point: DS_FAULT=flaky_prefill raises here; step() fails the
         # request and keeps serving
         fault_injection.maybe_fail("flaky_prefill", exc=RuntimeError,
@@ -721,8 +841,11 @@ class ServingEngine:
                                  jnp.asarray(ids), jnp.asarray([L], np.int32),
                                  rng)
         req.seq_len = L
+        req.prefill_done = L
         self._seq_lens[req.slot] = L
         self.metrics.prefill_tokens += L
+        self.metrics.prefill_tokens_computed += L
+        self.metrics.window_tokens += L
         if self.config.logit_guard and bool(np.asarray(bad)[0]):
             slot = req.slot
             self.sched.fail(req, "corrupt_logits")
@@ -731,6 +854,181 @@ class ServingEngine:
             self.metrics.requests_failed += 1
             return
         self._harvest(req, int(np.asarray(tok)[0]))
+
+    # -- chunked prefill (the prefill half of the mixed step) -----------
+
+    def _run_prefill_chunks(self) -> None:
+        """Spend this step's prefill token budget: round-robin one chunk at
+        a time across mid-prefill residents (admission order) until the
+        budget is gone or nobody is owed prefill. Decode always runs after
+        — the budget is what bounds prefill's share of the step."""
+        budget = self._chunk_budget
+        while budget > 0:
+            pending = sorted((r for _, r in self.sched.active()
+                              if r.prefilling),
+                             key=lambda r: r.admit_order)
+            if not pending:
+                return
+            progressed = False
+            for req in pending:
+                if budget <= 0:
+                    return
+                n = min(self._chunk, budget,
+                        req.prefill_target - req.prefill_done)
+                if n <= 0:
+                    continue
+                try:
+                    self._prefill_chunk(req, n)
+                except BlockPoolError:
+                    raise  # accounting invariant broken — never swallow
+                except StepWatchdogTimeout as e:
+                    # the chunk wedged on-device: fail ITS request with
+                    # watchdog semantics and stop dispatching this step —
+                    # the wedged-backend gate keeps later steps off the
+                    # device until the abandoned call clears
+                    log_dist(f"serving: chunked prefill watchdog tripped "
+                             f"for {req.rid}: {e}", ranks=[0])
+                    self.metrics.watchdog_trips += 1
+                    slot = req.slot
+                    self.sched.fail(req, "step_watchdog")
+                    self._clear_slot_arrays(slot)
+                    self.metrics.requests_failed += 1
+                    return
+                except Exception as e:
+                    self._fail_prefill(req, e)
+                    continue
+                budget -= n
+                progressed = True
+            if not progressed:
+                return
+
+    def _prefill_chunk(self, req: Request, n: int) -> None:
+        """Run ``n`` prompt tokens (<= the compiled chunk length) through
+        the resident chunked-prefill program. Chunk offset, valid length,
+        block table and cached-prefix length all ride as DATA — every call
+        reuses the one compile. The final chunk samples token one (TTFT)
+        and activates the slot for decode."""
+        fault_injection.maybe_fail("flaky_prefill", exc=RuntimeError,
+                                   tag="serving_prefill", step=self._step_no)
+        # chaos point: NaN this chunk's logits as DATA (no recompile) — the
+        # guard must quarantine the request BEFORE its pages are
+        # content-indexed, or the poison would be served to the next
+        # identical prompt
+        corrupt = fault_injection.maybe_flag(
+            "corrupt_logits", tag="serving_prefill",
+            step=self._step_no) is not None
+        tokens = req.resume_tokens
+        start = req.prefill_done
+        bs = self.block_pool.block_size
+        # COW any target page another sequence still references (reachable
+        # only through unusual sharing patterns — prefix matches are block-
+        # aligned — but appends into shared pages must be impossible by
+        # construction, not by luck)
+        for idx in range(start // bs, (start + n - 1) // bs + 1):
+            self._ensure_exclusive(req, idx)
+        row = np.full((1, self.nb_max), self.block_pool.sentinel, np.int32)
+        row[0, :len(req.blocks)] = req.blocks
+        ids = np.zeros((1, self._chunk), np.int32)
+        ids[0, :n] = tokens[start:start + n]
+        if self._chunked_prefill_fn is None:
+            self._chunked_prefill_fn = self._build_chunked_prefill()
+        self._rng, rng = jax.random.split(self._rng)
+        pool = self.pool  # snapshot for the guarded thread (decode rule)
+        row_j, ids_j = jnp.asarray(row), jnp.asarray(ids)
+        start_j = jnp.asarray([start], np.int32)
+        len_j = jnp.asarray([n], np.int32)
+        corrupt_j = jnp.asarray([corrupt])
+
+        step_no = self._step_no
+
+        def device_call():
+            # chaos point INSIDE the guarded region (the slow_step analog
+            # for the mixed step's prefill half)
+            fault_injection.maybe_stall("slow_chunk", tag="serving_prefill",
+                                        step=step_no)
+            return self._chunked_prefill_fn(self.engine.params, pool,
+                                            row_j, ids_j, start_j, len_j,
+                                            corrupt_j, rng)
+
+        # chunked prefill is the mixed step's OTHER device program, so the
+        # step watchdog bounds it exactly like decode (a wedged chunk must
+        # fail ITS request and keep the engine serving, not hang every
+        # tenant); the first call carries the XLA compile and is exempt
+        if self._chunked_warm:
+            tok, bad, self.pool = self._guarded(device_call)
+        else:
+            tok, bad, self.pool = device_call()
+            self._chunked_warm = True
+        req.prefill_done = start + n
+        req.seq_len = start + n
+        self.metrics.prefill_tokens += n
+        self.metrics.prefill_tokens_computed += n
+        self.metrics.window_tokens += n
+        # guard EVERY chunk (the chunk's last position attends everything
+        # before it, so NaN KV anywhere upstream surfaces here) and guard
+        # BEFORE content-indexing: a quarantined request's pages must
+        # blank on release, never park on the LRU where the next
+        # identical prompt would reuse the poisoned KV
+        if self.config.logit_guard and bool(np.asarray(bad)[0]):
+            slot = req.slot
+            self.sched.fail(req, "corrupt_logits")
+            self._clear_slot_arrays(slot)
+            self.metrics.logit_quarantines += 1
+            self.metrics.requests_failed += 1
+            return
+        self._commit_full_blocks(req)
+        if req.prefill_done < req.prefill_target:
+            return  # mid-prompt: no token sampled, slot stays decode-idle
+        # last chunk: activate the slot for the ragged decode step
+        self._write_table_row(req)
+        self._seq_lens[req.slot] = req.seq_len
+        self._harvest(req, int(np.asarray(tok)[0]))
+
+    def _ensure_exclusive(self, req: Request, block_idx: int) -> None:
+        """Copy-on-write guard for append paths: the page at ``block_idx``
+        of the request's table must be referenced ONLY by this request
+        before anything scatters into it. Shared pages are forked
+        (``BlockPool.cow``) and device-copied; the table is rewritten."""
+        if block_idx >= len(req.blocks):
+            return  # page not allocated yet (growth allocates exclusively)
+        bid = req.blocks[block_idx]
+        if not self.block_pool.is_shared(bid):
+            return
+        new = self.block_pool.cow(bid, req.rid)
+        if self._copy_blocks_fn is None:
+            from ...models.layers import copy_paged_blocks
+
+            r = self.engine._replicated
+            self._copy_blocks_fn = jax.jit(
+                copy_paged_blocks, donate_argnums=self._donate and (0,),
+                in_shardings=(r, r, r), out_shardings=r)
+        self.pool = self._copy_blocks_fn(self.pool,
+                                         jnp.asarray([bid], jnp.int32),
+                                         jnp.asarray([new], jnp.int32))
+        req.blocks[block_idx] = new
+        self.metrics.cow_copies += 1
+
+    def _commit_full_blocks(self, req: Request) -> None:
+        """Content-index every COMPLETELY written page of this sequence
+        (hash chained over the prefix) so later identical prompts reuse it.
+        Cheap and idempotent: already-indexed pages return immediately."""
+        if not self.config.prefix_cache:
+            return
+        bs = self.block_pool.block_size
+        full = req.seq_len // bs
+        tokens = None
+        while len(req.block_hashes) < full:
+            # generated tokens filled pages past the admission-time hashes
+            j = len(req.block_hashes)
+            if tokens is None:
+                tokens = req.resume_tokens
+            prev = req.block_hashes[j - 1] if j else None
+            req.block_hashes.append(self.block_pool.canonical_key(
+                chain_hash(prev, tokens[j * bs:(j + 1) * bs])))
+        for idx in range(req.committed_blocks, full):
+            self.block_pool.commit_hash(req.blocks[idx],
+                                        req.block_hashes[idx])
+        req.committed_blocks = max(req.committed_blocks, full)
 
     def _harvest(self, req: Request, token: int) -> None:
         """Account one sampled token; recycle the slot the step a sequence
@@ -822,6 +1120,47 @@ class ServingEngine:
         return jax.jit(prefill, donate_argnums=self._donate,
                        in_shardings=(self.engine.param_shardings,
                                      r, r, r, r, r),
+                       out_shardings=(r, r, r))
+
+    def _build_chunked_prefill(self):
+        """The ONE resident chunked-prefill program. Shapes are fixed —
+        ``[1, prefill_chunk_tokens]`` ids against the full pool — and the
+        chunk's absolute offset, valid length, block table and (implicitly,
+        through the table) cached-prefix length all ride as data, so chunk
+        position 0 of a cold prompt and chunk 7 behind a long prefix hit
+        run the SAME executable. ``chunk_start`` in the cache-index bundle
+        switches the model's paged branch to pool attention (cached prefix
+        + chunk), replacing the from-empty fresh-KV contract the bucketed
+        prefill relies on."""
+        module, scfg = self.engine.module, self.config
+        t_chunk = self._chunk
+
+        def chunked_prefill(params, pool, table_row, ids, start, length,
+                            corrupt, rng):
+            self.compile_counts["chunked_prefill"] += 1
+            params = self._dequant(params)
+            ar = jnp.arange(t_chunk)[None, :]
+            append_pos = jnp.where(ar < length[:, None],
+                                   start[:, None] + ar, -1)
+            idx = paged_cache_index(table_row, append_pos, start + length,
+                                    chunk_start=start)
+            logits, pool = module.apply({"params": params}, ids, cache=pool,
+                                        cache_index=idx)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            # corrupt_logits chaos (tag=serving_prefill): the flag is an
+            # INPUT, so the drill never recompiles
+            last = jnp.where(corrupt[:, None],
+                             jnp.asarray(jnp.nan, last.dtype), last)
+            bad = ~jnp.isfinite(last).all(axis=-1)
+            tok = _sample_logits(last, rng, scfg.do_sample, scfg.temperature,
+                                 scfg.top_k, scfg.top_p)
+            return tok.astype(jnp.int32), bad, pool
+
+        r = self.engine._replicated
+        return jax.jit(chunked_prefill, donate_argnums=self._donate,
+                       in_shardings=(self.engine.param_shardings,
+                                     r, r, r, r, r, r, r),
                        out_shardings=(r, r, r))
 
 

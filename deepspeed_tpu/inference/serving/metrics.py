@@ -49,13 +49,35 @@ class ServingMetrics:
     logit_quarantines: int = 0
     brownout_admissions: int = 0
     preemptions: int = 0
+    #: prompt tokens SERVED into request contexts (cached + recomputed):
+    #: the user-visible prefill volume
     prefill_tokens: int = 0
+    #: prompt tokens that actually ran through the model — cache hits are
+    #: excluded here, so compute throughput can never be inflated by
+    #: serving the same prefix twice
+    prefill_tokens_computed: int = 0
+    #: prompt tokens served from the prefix cache WITHOUT recompute
+    cached_prefill_tokens: int = 0
+    #: admissions that matched a non-empty cached prefix
+    prefix_hits: int = 0
+    #: copy-on-write page forks (appends routed off shared pages)
+    cow_copies: int = 0
     tokens_generated: int = 0
     steps: int = 0
     # gauges (overwritten each step)
     queue_depth: int = 0
     active_seqs: int = 0
     blocks_used: int = 0
+    #: refcount-0 pages kept warm in the prefix cache (reclaimable)
+    blocks_cached: int = 0
+    #: cached pages reclaimed to back new allocations (pool monotone)
+    prefix_evictions: int = 0
+    #: residents still owed prefill chunks this step
+    chunked_prefill_waiting: int = 0
+    #: age (s) of the OLDEST request still owed prefill chunks — the
+    #: chunked-prefill queue-age signal: it climbing means the prefill
+    #: token budget is starving long prompts
+    chunked_prefill_queue_age_s: float = 0.0
     brownout_active: bool = False
     # distributions (windowed to _WINDOW samples — see record_ttft/record_step)
     ttft_s: List[float] = field(default_factory=list)
@@ -82,8 +104,24 @@ class ServingMetrics:
 
     @property
     def tokens_per_sec(self) -> float:
+        """COMPUTE throughput: generated tokens + recomputed prefill
+        tokens per second. Prefix-cache hits are deliberately excluded —
+        they are served, not computed, and counting them would let a
+        prefix-heavy benchmark inflate its throughput artifact."""
         dt = time.perf_counter() - self.window_start
         return self.window_tokens / dt if dt > 0 else 0.0
+
+    @property
+    def served_tokens(self) -> int:
+        """Everything that entered request contexts: generated + prefill
+        (INCLUDING cache hits — the user-visible volume)."""
+        return self.tokens_generated + self.prefill_tokens
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of served prefill tokens that came from the cache."""
+        return self.cached_prefill_tokens / self.prefill_tokens \
+            if self.prefill_tokens else 0.0
 
     def snapshot(self) -> Dict[str, float]:
         out = {
@@ -93,6 +131,17 @@ class ServingMetrics:
             "kv_block_occupancy": self.occupancy,
             "tokens_per_sec": self.tokens_per_sec,
             "tokens_generated": float(self.tokens_generated),
+            "served_tokens": float(self.served_tokens),
+            "prefill_tokens": float(self.prefill_tokens),
+            "prefill_tokens_computed": float(self.prefill_tokens_computed),
+            "cached_prefill_tokens": float(self.cached_prefill_tokens),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "prefix_hits": float(self.prefix_hits),
+            "prefix_evictions": float(self.prefix_evictions),
+            "kv_blocks_cached": float(self.blocks_cached),
+            "cow_copies": float(self.cow_copies),
+            "chunked_prefill_waiting": float(self.chunked_prefill_waiting),
+            "chunked_prefill_queue_age_s": self.chunked_prefill_queue_age_s,
             "requests_submitted": float(self.requests_submitted),
             "requests_completed": float(self.requests_completed),
             "requests_failed": float(self.requests_failed),

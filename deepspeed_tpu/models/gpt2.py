@@ -19,6 +19,7 @@ from .layers import (cached_attention_xla,
                      cross_entropy_loss, dot_product_attention,
                      init_kv_cache, init_paged_kv_cache, is_paged_index,
                      key_mask_to_bias, paged_attention_reference,
+                     paged_prefill_attention_reference,
                      shift_labels, update_kv_cache, update_paged_kv_cache)
 
 
@@ -74,6 +75,13 @@ class GPT2Attention(nn.Module):
                 out = paged_attention_reference(
                     q[:, 0], layer_cache, cache_index["block_tables"],
                     cache_index["context_len"])[:, None]
+            elif "chunk_start" in cache_index:
+                # chunked prefill mid-prompt: the cached prefix lives only
+                # in the pool, so attend through the block tables (see
+                # LlamaAttention; gpt2 always takes the XLA reference)
+                out = paged_prefill_attention_reference(
+                    q, layer_cache, cache_index["block_tables"],
+                    cache_index["append_pos"], cache_index["context_len"])
             else:
                 # from-empty prefill: fresh K/V attention == cache attention
                 key_mask = (cache_index["append_pos"] >= 0).astype(jnp.int32)

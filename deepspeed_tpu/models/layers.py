@@ -371,7 +371,7 @@ def init_paged_kv_cache(num_blocks: int, block_size: int, num_kv_heads: int,
 
 
 def paged_cache_index(block_tables: jnp.ndarray, append_pos: jnp.ndarray,
-                      context_len: jnp.ndarray):
+                      context_len: jnp.ndarray, chunk_start=None):
     """Bundle the per-sequence paging state that rides through the model as
     ``cache_index`` (a plain dict threads the flax scan carry unchanged).
 
@@ -383,10 +383,17 @@ def paged_cache_index(block_tables: jnp.ndarray, append_pos: jnp.ndarray,
     token (``-1`` = padding, its KV write is dropped).
     ``context_len``: int32 ``[B]`` number of valid cached tokens AFTER this
     append (prefill: the prompt length; decode: ``seq_len + 1``).
+    ``chunk_start``: int32 ``[B]`` — present only on the CHUNKED prefill
+    path: absolute position of the chunk's first token. Its presence
+    switches the models' multi-token paged branch from fresh-KV (from-
+    empty) attention to pool attention over the cached prefix + chunk.
     """
-    return {"block_tables": jnp.asarray(block_tables, jnp.int32),
-            "append_pos": jnp.asarray(append_pos, jnp.int32),
-            "context_len": jnp.asarray(context_len, jnp.int32)}
+    out = {"block_tables": jnp.asarray(block_tables, jnp.int32),
+           "append_pos": jnp.asarray(append_pos, jnp.int32),
+           "context_len": jnp.asarray(context_len, jnp.int32)}
+    if chunk_start is not None:
+        out["chunk_start"] = jnp.asarray(chunk_start, jnp.int32)
+    return out
 
 
 def is_paged_index(cache_index) -> bool:
@@ -433,6 +440,34 @@ def update_paged_kv_cache(layer_cache, k, v, cache_index):
     }
 
 
+def _gather_pages_dense(layer_cache, block_tables, dtype, num_heads):
+    """Gather each sequence's pages into dense seq-major K/V rows
+    ``[B, H, S, D]`` (S = nb_max * bs), dequantizing an int8 pool and
+    expanding GQA kv heads over the head axis. Shared by the XLA paged
+    attention fallbacks (decode + chunked prefill)."""
+    num_blocks, Hkv, bs, D = layer_cache["k"].shape
+    bt = jnp.minimum(jnp.asarray(block_tables, jnp.int32), num_blocks - 1)
+    B, nb = bt.shape
+    S = nb * bs
+    k = layer_cache["k"][bt]                              # [B, nb, Hkv, bs, D]
+    v = layer_cache["v"][bt]
+    if "k_scale" in layer_cache:
+        k = dequantize_kv(k, layer_cache["k_scale"][bt], dtype)
+        v = dequantize_kv(v, layer_cache["v_scale"][bt], dtype)
+    else:
+        k = k.astype(dtype)
+        v = v.astype(dtype)
+    k = jnp.swapaxes(k, 1, 2).reshape(B, Hkv, S, D)
+    v = jnp.swapaxes(v, 1, 2).reshape(B, Hkv, S, D)
+    rep = num_heads // Hkv
+    if rep > 1:
+        k = jnp.broadcast_to(k[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, num_heads, S, D)
+        v = jnp.broadcast_to(v[:, :, None], (B, Hkv, rep, S, D)).reshape(
+            B, num_heads, S, D)
+    return k, v
+
+
 def paged_attention_reference(q, layer_cache, block_tables, context_len,
                               window: Optional[int] = None,
                               scale: Optional[float] = None):
@@ -444,27 +479,9 @@ def paged_attention_reference(q, layer_cache, block_tables, context_len,
     the TPU path is the block-table Pallas kernel
     (``ops/pallas/decode_attention.py paged_decode_attention``).
     """
-    num_blocks, Hkv, bs, D = layer_cache["k"].shape
-    bt = jnp.minimum(jnp.asarray(block_tables, jnp.int32), num_blocks - 1)
-    B, nb = bt.shape
-    S = nb * bs
-    k = layer_cache["k"][bt]                              # [B, nb, Hkv, bs, D]
-    v = layer_cache["v"][bt]
-    if "k_scale" in layer_cache:
-        k = dequantize_kv(k, layer_cache["k_scale"][bt], q.dtype)
-        v = dequantize_kv(v, layer_cache["v_scale"][bt], q.dtype)
-    else:
-        k = k.astype(q.dtype)
-        v = v.astype(q.dtype)
-    k = jnp.swapaxes(k, 1, 2).reshape(B, Hkv, S, D)
-    v = jnp.swapaxes(v, 1, 2).reshape(B, Hkv, S, D)
-    H = q.shape[1]
-    rep = H // Hkv
-    if rep > 1:
-        k = jnp.broadcast_to(k[:, :, None], (B, Hkv, rep, S, D)).reshape(
-            B, H, S, D)
-        v = jnp.broadcast_to(v[:, :, None], (B, Hkv, rep, S, D)).reshape(
-            B, H, S, D)
+    B, H, D = q.shape
+    k, v = _gather_pages_dense(layer_cache, block_tables, q.dtype, H)
+    S = k.shape[2]
     if scale is None:
         scale = 1.0 / np.sqrt(D)
     clen = jnp.asarray(context_len, jnp.int32)
@@ -476,6 +493,56 @@ def paged_attention_reference(q, layer_cache, block_tables, context_len,
     logits = jnp.einsum("bhd,bhsd->bhs", q, k).astype(jnp.float32) * scale
     probs = jax.nn.softmax(logits + bias, axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bhsd->bhd", probs, v)
+
+
+def paged_prefill_attention_reference(q, layer_cache, block_tables,
+                                      append_pos, context_len,
+                                      window: Optional[int] = None,
+                                      scale: Optional[float] = None):
+    """Chunked-prefill attention over the paged pool, pure-XLA fallback.
+
+    Unlike the from-empty serving prefill (attention over the FRESH K/V
+    only), a chunk arriving mid-prompt must attend the sequence's CACHED
+    prefix too — prefix-cache hits and earlier chunks live only in the
+    pool. ``q``: ``[B, T, H, D]`` (this chunk's queries, KV ALREADY
+    appended); ``append_pos``: ``[B, T]`` each query's absolute position
+    (``-1`` = padding — nothing visible, output dropped by the caller);
+    ``context_len``: ``[B]`` valid pool tokens after the append. Query at
+    position p sees kv positions <= p: causal across chunk boundaries with
+    the chunk offset riding as DATA, so one compiled program serves every
+    chunk position and cached-prefix length. TPU path:
+    ``ops/pallas/decode_attention.py paged_prefill_attention``.
+    """
+    B, T, H, D = q.shape
+    k, v = _gather_pages_dense(layer_cache, block_tables, q.dtype, H)
+    S = k.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    q_pos = jnp.asarray(append_pos, jnp.int32)            # [B, T]
+    clen = jnp.asarray(context_len, jnp.int32)
+    kv_pos = jnp.arange(S)[None, None, :]
+    visible = (kv_pos <= q_pos[:, :, None]) & (kv_pos < clen[:, None, None])
+    if window is not None:
+        visible = visible & (q_pos[:, :, None] - kv_pos < window)
+    # pad queries (append_pos < 0) see nothing; the uniform softmax they
+    # produce stays finite and the caller never reads those rows
+    bias = jnp.where(visible, 0.0, -1e9).astype(jnp.float32)[:, None]
+    logits = jnp.einsum("bqhd,bhsd->bhqs", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(logits + bias, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqs,bhsd->bqhd", probs, v)
+
+
+def copy_paged_blocks(pool, src_ids, dst_ids):
+    """Device-side page copy ``pool[:, dst] = pool[:, src]`` across every
+    pool array (K, V, int8 scales) — the copy half of copy-on-write when a
+    sequence must append into a page other sequences still reference. Pool
+    arrays carry the leading layer axis ``[L, N, ...]`` (the serving
+    engine's layout); ``src_ids``/``dst_ids`` are equal-length int32
+    vectors."""
+    src = jnp.asarray(src_ids, jnp.int32)
+    dst = jnp.asarray(dst_ids, jnp.int32)
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst].set(a[:, src]), pool)
 
 
 def key_mask_to_bias(attention_mask: jnp.ndarray) -> jnp.ndarray:

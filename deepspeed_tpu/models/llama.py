@@ -26,7 +26,8 @@ from .layers import (RMSNorm, apply_rotary,
                      cross_entropy_loss, lm_head_output,
                      dot_product_attention, init_kv_cache,
                      init_paged_kv_cache, is_paged_index, key_mask_to_bias,
-                     paged_attention_reference, repeat_kv,
+                     paged_attention_reference,
+                     paged_prefill_attention_reference, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache, update_paged_kv_cache)
 
@@ -157,6 +158,32 @@ class LlamaAttention(nn.Module):
                         q[:, 0], layer_cache, cache_index["block_tables"],
                         cache_index["context_len"],
                         window=cfg.sliding_window)[:, None]
+            elif "chunk_start" in cache_index:
+                # CHUNKED prefill: this chunk may sit mid-prompt, with the
+                # cached prefix (prefix-cache hits + earlier chunks) living
+                # only in the POOL — fresh-KV attention would drop it. The
+                # chunk offset and prefix length ride as data, so every
+                # chunk position / hit length reuses one compiled program.
+                # Shared (refcount>1) pages are never appended into: the
+                # engine copies-on-write before routing writes here.
+                if cfg.decode_attention_impl == "pallas":
+                    from ..ops.pallas.decode_attention import \
+                        paged_prefill_attention
+
+                    out = paged_prefill_attention(
+                        q, layer_cache["k"], layer_cache["v"],
+                        cache_index["block_tables"],
+                        cache_index["chunk_start"],
+                        cache_index["context_len"],
+                        k_scale=layer_cache.get("k_scale"),
+                        v_scale=layer_cache.get("v_scale"),
+                        window=cfg.sliding_window)
+                else:
+                    out = paged_prefill_attention_reference(
+                        q, layer_cache, cache_index["block_tables"],
+                        cache_index["append_pos"],
+                        cache_index["context_len"],
+                        window=cfg.sliding_window)
             else:
                 # serving prefill always starts a sequence from an EMPTY
                 # span of pages, so attention over the FRESH K/V equals

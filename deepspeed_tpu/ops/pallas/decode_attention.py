@@ -399,3 +399,189 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         interpret=interpret,
     )(bt, clen, qg, k_pages, v_pages, *scales)
     return out.reshape(B, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Paged CHUNKED-PREFILL attention — the serving layer's mixed-step kernel
+#
+# Same per-(sequence, kv head) page walk as the decode kernel, but the query
+# operand is a whole prefill CHUNK: [T] tokens whose absolute positions start
+# at a per-sequence offset that rides in the scalar prefetch (chunk_start),
+# never in the compiled shape. Row t of the chunk sits at position
+# chunk_start + t and sees kv positions <= that — causality across chunk
+# boundaries AND over any prefix-cache hit, with zero recompiles as chunks
+# advance or hit lengths vary. This is the prefill half of "Ragged Paged
+# Attention": prefill raggedness is data over the same paged pool the decode
+# kernel reads.
+# ---------------------------------------------------------------------------
+
+
+def _paged_prefill_kernel(bt_ref, cs_ref, cl_ref, q_ref, k_ref, v_ref, *rest,
+                          sm_scale: float, block_size: int, group: int,
+                          window, int8: bool):
+    if int8:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = cs_ref[b]
+    clen = cl_ref[b]
+    # pages wholly beyond the context are skipped (their index map revisits
+    # the last real page, so the DMA is also elided); with a sliding window
+    # pages wholly below the FIRST chunk row's window are skipped too
+    run = ik * block_size < clen
+    if window is not None:
+        run = run & ((ik + 1) * block_size > start - window)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)      # [T*G, D]
+        k = k_ref[0, 0].astype(jnp.float32)      # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)      # [bs, D]
+        if int8:
+            k = k * ks_ref[0, 0][:, None]
+            v = v * vs_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        # row r is the (r // group)-th chunk token at absolute position
+        # start + r // group; chunk-padding rows (position >= clen) end up
+        # all-masked — their l stays 0 and _finalize writes zeros
+        q_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // group
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + ik * block_size
+        valid = (cols <= q_pos) & (cols < clen) & (q_pos < clen)
+        if window is not None:
+            valid = valid & (q_pos - cols < window)
+        s = jnp.where(valid, s, NEG_INF)
+        # pool pages are always materialized full (bs x D block == page), so
+        # no hardware edge padding can poison dot(p, v) — same argument as
+        # the paged decode kernel
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                            v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                            chunk_start: jnp.ndarray,
+                            context_lens: jnp.ndarray,
+                            sm_scale: Optional[float] = None,
+                            interpret: Optional[bool] = None,
+                            force_pallas: bool = False,
+                            window: Optional[int] = None,
+                            k_scale: Optional[jnp.ndarray] = None,
+                            v_scale: Optional[jnp.ndarray] = None
+                            ) -> jnp.ndarray:
+    """Chunked-prefill attention over a paged KV pool via block tables.
+
+    ``q``: ``[B, T, H, D]`` (one prefill chunk per sequence, KV ALREADY
+    appended to the pool); ``chunk_start``: int32 ``[B]`` absolute position
+    of each chunk's first token (tokens before it — prefix-cache hits and
+    earlier chunks — are read from the pool); ``context_lens``: int32
+    ``[B]`` valid tokens after this append, so a chunk shorter than ``T``
+    pads at the tail (rows past ``context_lens`` return zeros). Causality
+    is per row: chunk token t sees kv positions ``<= chunk_start + t``.
+
+    Both the chunk offset and the cached-prefix length are scalar-prefetch
+    DATA — every chunk position and every hit length reuses ONE compiled
+    program. ``interpret=None`` auto-selects: real kernel on TPU, the
+    gather-based XLA reference elsewhere.
+    """
+    int8 = k_scale is not None
+    B, T, H, D = q.shape
+    if interpret is None:
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu and not force_pallas:
+            from ...models.layers import paged_prefill_attention_reference
+
+            cache = {"k": k_pages, "v": v_pages}
+            if int8:
+                cache["k_scale"], cache["v_scale"] = k_scale, v_scale
+            pos = jnp.asarray(chunk_start, jnp.int32)[:, None] \
+                + jnp.arange(T)[None, :]
+            pos = jnp.where(
+                pos < jnp.asarray(context_lens, jnp.int32)[:, None], pos, -1)
+            return paged_prefill_attention_reference(
+                q, cache, block_tables, pos, context_lens, window=window,
+                scale=sm_scale)
+        interpret = not on_tpu
+    N, Hkv, bs, _ = k_pages.shape
+    if H % Hkv:
+        raise ValueError(f"query heads {H} must divide into kv heads {Hkv}")
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    nb = block_tables.shape[1]
+
+    # rows grouped [T, G] per kv head: row r = chunk token r // G, query
+    # head r % G — the same [B, Hkv, rows, D] layout as the decode kernel,
+    # just with T*G rows instead of G
+    qg = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, Hkv, T * G, D)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    cs = jnp.asarray(chunk_start, jnp.int32)
+    clen = jnp.asarray(context_lens, jnp.int32)
+
+    def kv_idx(b, h, ik, bt_ref, cs_ref, cl_ref):
+        last = jnp.maximum(cl_ref[b] - 1, 0) // bs
+        pid = bt_ref[b, jnp.minimum(ik, last)]
+        return (jnp.minimum(pid, N - 1), h, 0, 0)
+
+    def scale_idx(b, h, ik, bt_ref, cs_ref, cl_ref):
+        last = jnp.maximum(cl_ref[b] - 1, 0) // bs
+        pid = bt_ref[b, jnp.minimum(ik, last)]
+        return (jnp.minimum(pid, N - 1), h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, T * G, D), lambda b, h, ik, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D), kv_idx),
+        pl.BlockSpec((1, 1, bs, D), kv_idx),
+    ]
+    if int8:
+        in_specs += [pl.BlockSpec((1, 1, bs), scale_idx)] * 2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Hkv, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, T * G, D),
+                               lambda b, h, ik, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((T * G, 1), jnp.float32),
+            pltpu.VMEM((T * G, 1), jnp.float32),
+            pltpu.VMEM((T * G, D), jnp.float32),
+        ],
+    )
+    scales = []
+    if int8:
+        scales = [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    out = pl.pallas_call(
+        functools.partial(_paged_prefill_kernel, sm_scale=sm_scale,
+                          block_size=bs, group=G, window=window, int8=int8),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, T * G, D), q.dtype),
+        interpret=interpret,
+    )(bt, cs, clen, qg, k_pages, v_pages, *scales)
+    return out.reshape(B, Hkv, T, G, D).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, T, H, D)

@@ -72,6 +72,7 @@ def test_checkpoint_mesh_resize_on_load(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_zero_to_fp32_consolidation(tmp_path):
     from deepspeed_tpu.utils.zero_to_fp32 import (
         convert_zero_checkpoint_to_fp32_state_dict,
@@ -127,6 +128,7 @@ def test_save_16bit_model(tmp_path):
                                rtol=1e-2, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_pipeline_engine_checkpoint_roundtrip(tmp_path):
     import flax.linen as nn
 

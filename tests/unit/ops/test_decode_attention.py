@@ -74,7 +74,8 @@ def test_cpu_fallback_matches_and_model_wiring():
     np.testing.assert_array_equal(outs["xla"], outs["pallas"])
 
 
-@pytest.mark.parametrize("family", ["opt", "gpt_neox", "phi"])
+@pytest.mark.parametrize("family", [
+    pytest.param("opt", marks=pytest.mark.slow), "gpt_neox", "phi"])
 def test_generic_transformer_pallas_decode_wiring(family):
     """decode_attention_impl='pallas' on the generic transformer generates
     identical tokens to the xla decode path for eligible families (no
@@ -341,3 +342,109 @@ def test_no_cache_sized_copy_in_xla_decode_path_either():
                             walk(e.jaxpr)
 
     walk(jaxpr.jaxpr)
+
+
+def _paged_prefill_setup(rs, B=2, H=4, Hkv=2, D=16, bs=8, n_pool=16, nb=6,
+                         starts=(10, 0), chunk_lens=(5, 3), T=8, int8=False):
+    """Pools with a CACHED PREFIX per sequence plus a freshly appended
+    chunk: seq b holds ``starts[b]`` prefix tokens, then ``chunk_lens[b]``
+    chunk tokens (chunk queries pad to T)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.layers import (init_paged_kv_cache,
+                                             paged_cache_index,
+                                             update_paged_kv_cache)
+
+    pool = init_paged_kv_cache(n_pool, bs, Hkv, D,
+                               dtype=jnp.int8 if int8 else jnp.float32)
+    starts = np.asarray(starts, np.int32)
+    chunk_lens = np.asarray(chunk_lens, np.int32)
+    clen = starts + chunk_lens
+    bt = np.full((B, nb), n_pool, np.int32)
+    free = iter(range(1, n_pool))
+    for b in range(B):
+        need = -(-int(clen[b]) // bs)
+        bt[b, :need] = [next(free) for _ in range(need)]
+    # write the cached prefixes
+    for b in range(B):
+        L = int(starts[b])
+        if not L:
+            continue
+        pk = rs.randn(1, L, Hkv, D).astype(np.float32)
+        pv = rs.randn(1, L, Hkv, D).astype(np.float32)
+        idx = paged_cache_index(jnp.asarray(bt[b:b + 1]),
+                                jnp.asarray(np.arange(L)[None]),
+                                jnp.asarray([L]))
+        pool = update_paged_kv_cache(pool, jnp.asarray(pk), jnp.asarray(pv),
+                                     idx)
+    # append the chunks (padded to T; pads carry append_pos=-1)
+    ck = rs.randn(B, T, Hkv, D).astype(np.float32)
+    cv = rs.randn(B, T, Hkv, D).astype(np.float32)
+    pos = starts[:, None] + np.arange(T)[None]
+    pos = np.where(np.arange(T)[None] < chunk_lens[:, None], pos,
+                   -1).astype(np.int32)
+    idx = paged_cache_index(jnp.asarray(bt), jnp.asarray(pos),
+                            jnp.asarray(clen))
+    pool = update_paged_kv_cache(pool, jnp.asarray(ck), jnp.asarray(cv), idx)
+    q = jnp.asarray(rs.randn(B, T, H, D).astype(np.float32))
+    return (pool, q, jnp.asarray(bt), jnp.asarray(starts),
+            jnp.asarray(clen), jnp.asarray(pos), chunk_lens)
+
+
+@pytest.mark.serving
+@pytest.mark.parametrize("window", [None, 6])
+def test_paged_prefill_kernel_parity_vs_reference(window):
+    """Chunked-prefill kernel (interpret mode) == the gather-based XLA
+    reference across cached prefixes, ragged chunk lengths and chunk
+    padding — per-row causality at chunk_start + t, offsets as data."""
+    from deepspeed_tpu.models.layers import paged_prefill_attention_reference
+    from deepspeed_tpu.ops.pallas.decode_attention import \
+        paged_prefill_attention
+
+    (pool, q, bt, starts, clen, pos,
+     chunk_lens) = _paged_prefill_setup(np.random.RandomState(43))
+    ref = paged_prefill_attention_reference(q, pool, bt, pos, clen,
+                                            window=window)
+    got = paged_prefill_attention(q, pool["k"], pool["v"], bt, starts, clen,
+                                  force_pallas=True, interpret=True,
+                                  window=window)
+    valid = np.arange(q.shape[1])[None] < np.asarray(chunk_lens)[:, None]
+    np.testing.assert_allclose(np.asarray(got)[valid], np.asarray(ref)[valid],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.serving
+def test_paged_prefill_kernel_int8_parity():
+    from deepspeed_tpu.models.layers import paged_prefill_attention_reference
+    from deepspeed_tpu.ops.pallas.decode_attention import \
+        paged_prefill_attention
+
+    (pool, q, bt, starts, clen, pos,
+     chunk_lens) = _paged_prefill_setup(np.random.RandomState(47), int8=True)
+    ref = paged_prefill_attention_reference(q, pool, bt, pos, clen)
+    got = paged_prefill_attention(q, pool["k"], pool["v"], bt, starts, clen,
+                                  k_scale=pool["k_scale"],
+                                  v_scale=pool["v_scale"],
+                                  force_pallas=True, interpret=True)
+    valid = np.arange(q.shape[1])[None] < np.asarray(chunk_lens)[:, None]
+    np.testing.assert_allclose(np.asarray(got)[valid], np.asarray(ref)[valid],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.serving
+def test_paged_prefill_decode_agreement_at_chunk_len_one():
+    """A one-token chunk IS a decode step: the prefill kernel at
+    chunk_len=1 must agree with the decode kernel on the same pool."""
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention, paged_prefill_attention)
+
+    (pool, q, bt, starts, clen, pos,
+     chunk_lens) = _paged_prefill_setup(np.random.RandomState(53),
+                                        starts=(12, 7), chunk_lens=(1, 1),
+                                        T=1)
+    dec = paged_decode_attention(q[:, 0], pool["k"], pool["v"], bt, clen,
+                                 interpret=True, force_pallas=True)
+    pre = paged_prefill_attention(q, pool["k"], pool["v"], bt, starts, clen,
+                                  interpret=True, force_pallas=True)
+    np.testing.assert_allclose(np.asarray(pre)[:, 0], np.asarray(dec),
+                               rtol=2e-5, atol=2e-5)

@@ -79,6 +79,11 @@ def test_running_deadline_terminal_timeout_keeps_partial_tokens(clean):
     deadline = time.perf_counter() + 5.0
     while not srv.poll(rid).state == "timeout":
         assert time.perf_counter() < deadline, "deadline never enforced"
+        # throttle: a warm engine can decode all 25 tokens inside the
+        # 0.25s budget on a fast box, finishing BEFORE the deadline and
+        # turning this into a flake — pace steps so the deadline always
+        # lands mid-generation
+        time.sleep(0.02)
         srv.step()
     o = srv.poll(rid)
     assert o.finish_reason == "deadline"

@@ -1,0 +1,735 @@
+"""Prefix caching + chunked prefill: pool invariants (refcounts, COW,
+LRU eviction), the token-budgeted mixed step, and the r8 acceptance bar —
+shared-prefix traffic served token-identically to uncached generate with
+EXACTLY the two resident compiles (decode + chunked prefill).
+
+Compile budget: the fast tier shares one prefix-cache ServingEngine
+(module fixture); every test drains it, so later tests start from an
+empty SCHEDULE but a warm prefix cache — tests that need a cold cache
+flush it explicitly via a fresh engine (slow tier) or distinct prompts.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+from deepspeed_tpu.inference.serving.block_pool import (BlockPool,
+                                                        BlockPoolError)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# pool-level invariants (pure host accounting, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_refcounts_shared_pages_and_release_order():
+    pool = BlockPool(8, 4)
+    a = pool.allocate(2, "a")
+    pool.commit_hash(a[0], 111)
+    pool.acquire([a[0]], "b")          # b shares a's first page
+    assert pool.ref_count(a[0]) == 2 and pool.is_shared(a[0])
+    assert pool.used_count == 2
+    pool.free([a[0]], "b")             # b lets go: still referenced by a
+    assert pool.ref_count(a[0]) == 1 and not pool.is_shared(a[0])
+    pool.free(a, "a")                  # hashed page -> cached, other -> blank
+    assert pool.used_count == 0 and pool.cached_count == 1
+    pool.check_consistent()
+    # refcounts can never go negative: a second release raises
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free([a[0]], "a")
+    pool.check_consistent()
+
+
+def test_acquire_dead_or_duplicate_reference_raises():
+    pool = BlockPool(4, 4)
+    a = pool.allocate(1, "a")
+    with pytest.raises(BlockPoolError, match="already references"):
+        pool.acquire(a, "a")
+    pool.free(a, "a")                  # unhashed -> blank, not cached
+    with pytest.raises(BlockPoolError, match="dead block"):
+        pool.acquire(a, "b")
+    pool.check_consistent()
+
+
+def test_cow_never_mutates_shared_page_accounting():
+    """COW forks the WRITER off the shared page: the original page keeps
+    its other references and its content hash; the fork is exclusive and
+    unhashed (its content is about to diverge)."""
+    pool = BlockPool(8, 4)
+    a = pool.allocate(1, "a")
+    pool.commit_hash(a[0], 42)
+    pool.acquire(a, "b")
+    new = pool.cow(a[0], "b")
+    assert new != a[0]
+    assert pool.ref_count(a[0]) == 1 and pool.owner_of(a[0]) == "a"
+    assert pool.ref_count(new) == 1 and pool.owner_of(new) == "b"
+    assert pool.lookup(42) == a[0]     # the shared page stays indexed
+    pool.check_consistent()
+    # exclusive page: cow is a no-op (same id back, no copy needed)
+    assert pool.cow(new, "b") == new
+    with pytest.raises(BlockPoolError, match="not held"):
+        pool.cow(a[0], "intruder")
+
+
+def test_eviction_lru_order_and_never_drops_referenced():
+    pool = BlockPool(4, 4)
+    a = pool.allocate(2, "a")          # referenced — structurally safe
+    b = pool.allocate(2, "b")
+    pool.commit_hash(b[0], 100)
+    pool.commit_hash(b[1], 101)
+    pool.free(b, "b")                  # both parked on the cached LRU
+    assert pool.cached_count == 2 and pool.free_count == 2
+    # one blank is needed beyond the cached ones -> oldest cached evicts
+    [c] = pool.allocate(1, "c")
+    assert pool.evictions == 1
+    assert pool.lookup(100) is None    # b[0] was LRU -> evicted, unindexed
+    assert pool.lookup(101) == b[1]    # newer cached page survives
+    # referenced pages never evict: exhausting the pool raises instead
+    pool.allocate(1, "d")
+    with pytest.raises(BlockPoolError, match="exhausted"):
+        pool.allocate(1, "e")
+    for bid in a:
+        assert pool.ref_count(bid) == 1
+    pool.check_consistent()
+
+
+def test_match_prefix_chained_and_capped():
+    pool = BlockPool(8, 4)
+    tokens = list(range(1, 13))        # 3 full blocks
+    hashes = pool.prefix_block_hashes(tokens)
+    assert len(hashes) == 3
+    blocks = pool.allocate(3, "a")
+    for bid, h in zip(blocks, hashes):
+        pool.commit_hash(bid, h)
+    pool.free(blocks, "a")
+    # full prompt cached: the cap leaves the LAST block uncached so at
+    # least one token is computed (logits must come from somewhere)
+    assert pool.match_prefix(tokens) == blocks[:2]
+    assert pool.match_prefix(tokens + [99]) == blocks[:3]
+    # divergence in the middle breaks the chain even with equal tails
+    diverged = tokens[:4] + [77] + tokens[5:]
+    assert pool.match_prefix(diverged) == blocks[:1]
+    assert pool.uncached_suffix_blocks(tokens + [99]) == 1
+    pool.check_consistent()
+
+
+def test_chain_key_long_chain_no_recursion_and_exact_equality():
+    """ChainKey equality walks the chain ITERATIVELY: two independently
+    built 3000-block chains (a ~48k-token prompt at bs=16) must compare
+    equal without RecursionError, a one-token divergence anywhere must
+    compare unequal, and hashing is O(1) (cached digest)."""
+    from deepspeed_tpu.inference.serving.block_pool import chain_hash
+
+    def build(tokens, bs=16):
+        out, prev = [], None
+        for i in range(len(tokens) // bs):
+            prev = chain_hash(prev, tokens[i * bs:(i + 1) * bs])
+            out.append(prev)
+        return out
+
+    tokens = list(range(3000 * 16))
+    a, b = build(tokens), build(tokens)
+    assert a[-1] == b[-1]                 # deep TRUE match, no recursion
+    assert hash(a[-1]) == hash(b[-1])
+    diverged = list(tokens)
+    diverged[5] += 1                      # first block differs
+    c = build(diverged)
+    assert a[-1] != c[-1] and a[0] != c[0]
+    assert a[10] == b[10] and {a[-1]: 1}[b[-1]] == 1  # dict hit works
+
+
+def test_prefix_block_hashes_interns_against_the_index():
+    """A rebuilt chain over indexed content must come back as the STORED
+    key objects, so later dict ops on it stop at the identity fast path
+    instead of re-comparing tokens O(depth) deep per lookup."""
+    pool = BlockPool(8, 4)
+    tokens = list(range(1, 13))            # 3 full blocks
+    committed = pool.prefix_block_hashes(tokens)
+    blocks = pool.allocate(3, "a")
+    for bid, h in zip(blocks, committed):
+        pool.commit_hash(bid, h)
+    rebuilt = pool.prefix_block_hashes(tokens)
+    for fresh, stored in zip(rebuilt, committed):
+        assert fresh is stored
+    # divergence at block 1 ends interning there, not before
+    diverged = pool.prefix_block_hashes(tokens[:4] + [77] + tokens[5:])
+    assert diverged[0] is committed[0]
+    assert diverged[1] is not committed[1] and diverged[1] != committed[1]
+    # unindexed content passes through untouched
+    cold = pool.prefix_block_hashes([101, 102, 103, 104])
+    assert pool.canonical_key(cold[0]) is cold[0]
+
+
+def test_admission_charges_dedup_pinned_across_sharers():
+    """N queued requests sharing one cached prefix pin its pages ONCE:
+    the gate scan charges the pinned pages to the first sharer only, so
+    a same-system-prompt burst (the workload the cache serves) is not
+    overstated N-fold into spurious kv_headroom rejects."""
+    from deepspeed_tpu.inference.serving.scheduler import Request, Scheduler
+
+    pool = BlockPool(32, 8)
+    sched = Scheduler(4, pool, 32, prefix_cache=True)
+    prefix = list(range(1, 25))                  # 3 full blocks
+    seed_hashes = pool.prefix_block_hashes(prefix)
+    blocks = pool.allocate(3, "seed")
+    for bid, h in zip(blocks, seed_hashes):
+        pool.commit_hash(bid, h)
+    pool.free(blocks, "seed")                    # 3 pages idle on the LRU
+    reqs = [Request(prompt=prefix + [100 + i], max_new_tokens=2)
+            for i in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    charges, newcomer = sched.admission_charges(
+        newcomer_len=len(prefix) + 1,
+        newcomer_hashes=pool.prefix_block_hashes(prefix + [99]))
+    # first sharer pays 3 pinned + 1 suffix; the rest (and the newcomer)
+    # pay their 1-block suffix only
+    assert charges[reqs[0].rid] == 4
+    assert all(charges[r.rid] == 1 for r in reqs[1:])
+    assert newcomer == 1
+    assert sched.queued_block_demand() == 7
+
+
+def test_property_shared_cycles_never_leak_never_negative():
+    """Random allocate/acquire/free/cow/evict interleavings: after every
+    op the pool partitions into blank + cached + referenced, refcounts
+    stay positive, and eviction never touches a referenced page."""
+    rs = np.random.RandomState(0)
+    pool = BlockPool(24, 4)
+    live = {}                          # owner -> block ids (refs held)
+    hashed = 0
+    for step in range(800):
+        r = rs.rand()
+        if live and r < 0.35:
+            owner = rs.choice(sorted(live))
+            pool.free(live.pop(owner), owner)
+        elif live and r < 0.50:        # share a random live page
+            owner = rs.choice(sorted(live))
+            donor = live[owner]
+            bid = donor[rs.randint(len(donor))]
+            new_owner = f"s{step}"
+            if new_owner not in live:
+                pool.acquire([bid], new_owner)
+                live[new_owner] = [bid]
+        elif live and r < 0.60:        # cow a shared page
+            owner = rs.choice(sorted(live))
+            bid = live[owner][0]
+            if pool.is_shared(bid) and pool.can_allocate(1):
+                others = pool.ref_count(bid) - 1
+                new = pool.cow(bid, owner)
+                live[owner][0] = new
+                assert pool.ref_count(bid) == others  # untouched for others
+        else:
+            n = int(rs.randint(1, 4))
+            owner = f"r{step}"
+            if pool.can_allocate(n):
+                live[owner] = pool.allocate(n, owner)
+                if rs.rand() < 0.5:    # index some pages -> cached on free
+                    pool.commit_hash(live[owner][0], hash((step, hashed)))
+                    hashed += 1
+        pool.check_consistent()
+        for owner, bids in live.items():
+            for bid in set(bids):
+                assert pool.ref_count(bid) >= 1
+    for owner, bids in live.items():
+        pool.free(bids, owner)
+    pool.check_consistent()
+    assert pool.used_count == 0
+
+
+def test_defrag_remaps_refs_cache_and_hash_index():
+    pool = BlockPool(16, 4)
+    a = pool.allocate(3, "a")
+    b = pool.allocate(2, "b")
+    pool.commit_hash(b[0], 7)
+    pool.acquire([b[0]], "a")          # shared page crosses the defrag
+    pool.free(a, "a")                  # holes at the low end
+    mapping, src = pool.defrag_plan()
+    pool.check_consistent()
+    nb0 = mapping[b[0]]
+    assert pool.ref_count(nb0) == 2    # both references survived the move
+    assert pool.lookup(7) == nb0       # content index follows the page
+    for old, new in mapping.items():
+        assert src[new] == old
+
+
+# ---------------------------------------------------------------------------
+# engine-level: the r8 acceptance bar + mixed-step behavior
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+@pytest.fixture(scope="module")
+def srv_pc(llama_engine):
+    """Shared prefix-cache engine: block 8, chunk 16, token budget 16."""
+    return ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=4, block_size=8, num_blocks=48, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+
+
+def _reference(engine, prompt, max_new):
+    return [int(t) for t in np.asarray(engine.generate(
+        np.asarray(prompt)[None], max_new_tokens=max_new,
+        do_sample=False))[0]]
+
+
+def test_acceptance_shared_prefix_token_identical_two_resident_compiles(
+        srv_pc, llama_engine):
+    """THE acceptance test: shared-prefix traffic through the prefix cache
+    + chunked prefill is token-identical to uncached per-request generate,
+    with EXACTLY the two resident programs compiled — one ragged decode,
+    one chunked prefill; the bucketed prefill never runs and nothing
+    recompiles across chunk positions or hit lengths."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(0)
+    prefix = rs.randint(1, vocab, 24)           # 3 full blocks
+    # seed the cache (a request's pages are indexed as its chunks land,
+    # so concurrent SAME-STEP admissions can't hit a cache that is still
+    # being written — the seed runs to completion first)
+    seed = srv_pc.submit(np.concatenate([prefix, rs.randint(1, vocab, 2)]),
+                         max_new_tokens=2)
+    srv_pc.run()
+    assert srv_pc.poll(seed).state == "finished"
+    specs = [(np.concatenate([prefix, rs.randint(1, vocab, int(t))]), n)
+             for t, n in ((3, 6), (5, 4), (9, 5), (2, 7), (6, 4), (4, 6))]
+    rids = [srv_pc.submit(p, max_new_tokens=n) for p, n in specs]
+    outs = srv_pc.run()
+    assert srv_pc.compile_counts == {"decode": 1, "prefill": 0,
+                                     "chunked_prefill": 1}, \
+        srv_pc.compile_counts
+    for rid, (p, n) in zip(rids, specs):
+        o = outs[rid]
+        assert o.state == "finished"
+        assert o.tokens == _reference(llama_engine, p, n), \
+            f"{rid} diverged under prefix caching"
+    m = srv_pc.metrics
+    assert m.prefix_hits >= len(specs)          # every spec rode the seed
+    assert m.cached_prefill_tokens >= 24 * len(specs)
+    # served volume counts cache hits; compute volume must NOT
+    assert m.prefill_tokens == m.prefill_tokens_computed \
+        + m.cached_prefill_tokens
+    assert m.prefill_tokens_computed < m.prefill_tokens
+    srv_pc.block_pool.check_consistent()
+    assert srv_pc.block_pool.used_count == 0    # cached pages are refcount-0
+    assert srv_pc.block_pool.cached_count > 0   # ... and kept warm
+
+
+def test_chunked_prefill_does_not_block_resident_decoders(srv_pc,
+                                                          llama_engine):
+    """The mixed step's token budget: while a LONG prompt prefills in
+    chunks, an already-resident decoder must gain one token EVERY step —
+    no prefill head-of-line blocking."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(3)
+    short = srv_pc.submit(rs.randint(1, vocab, 5), max_new_tokens=12)
+    srv_pc.step()                                # short is decoding now
+    long_prompt = rs.randint(1, vocab, 50)       # 4 chunks at 16
+    long = srv_pc.submit(long_prompt, max_new_tokens=3)
+    progress = []
+    while srv_pc.poll(long).state == "queued" or \
+            not srv_pc.poll(long).tokens:
+        before = len(srv_pc.poll(short).tokens)
+        srv_pc.step()
+        if srv_pc.poll(short).state == "finished":
+            break
+        progress.append(len(srv_pc.poll(short).tokens) - before)
+    # every step while the long prompt chunked through, the short decoder
+    # still produced its token
+    assert progress and all(d == 1 for d in progress), progress
+    srv_pc.run()
+    assert srv_pc.poll(long).tokens == _reference(llama_engine, long_prompt,
+                                                  3)
+    assert srv_pc.poll(short).tokens == _reference(
+        llama_engine, np.asarray(srv_pc.poll(short).prompt), 12)
+
+
+def test_cache_reuse_across_completed_requests(srv_pc, llama_engine):
+    """A finished request's pages park on the LRU; an identical prompt
+    later reuses them (hits > 0, computed prefill shrinks) and still
+    produces identical tokens."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(1, vocab, 21)
+    ref = _reference(llama_engine, prompt, 5)
+    r1 = srv_pc.submit(prompt, max_new_tokens=5)
+    srv_pc.run()
+    computed_before = srv_pc.metrics.prefill_tokens_computed
+    cached_before = srv_pc.metrics.cached_prefill_tokens
+    r2 = srv_pc.submit(prompt, max_new_tokens=5)
+    srv_pc.run()
+    assert srv_pc.poll(r1).tokens == ref
+    assert srv_pc.poll(r2).tokens == ref
+    # 21 tokens = 2 full blocks (16) cached + 5 recomputed
+    assert srv_pc.metrics.cached_prefill_tokens - cached_before == 16
+    assert srv_pc.metrics.prefill_tokens_computed - computed_before == 5
+
+
+def test_generated_blocks_feed_multiturn_reuse(srv_pc, llama_engine):
+    """Pages FILLED BY DECODE are content-indexed too: replaying
+    prompt+answer as the next turn's prompt hits the cache past the
+    original prompt."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(1, vocab, 11)
+    r1 = srv_pc.submit(prompt, max_new_tokens=8)  # 11 + 8 = 19 -> 2 blocks
+    srv_pc.run()
+    turn1 = srv_pc.poll(r1).tokens
+    cached_before = srv_pc.metrics.cached_prefill_tokens
+    followup = np.concatenate([prompt, turn1, rs.randint(1, vocab, 4)])
+    r2 = srv_pc.submit(followup, max_new_tokens=4)
+    srv_pc.run()
+    assert srv_pc.metrics.cached_prefill_tokens - cached_before == 16
+    assert srv_pc.poll(r2).tokens == _reference(llama_engine, followup, 4)
+
+
+def test_preemption_with_prefix_cache_keeps_outputs_exact(llama_engine):
+    """Pool pressure forces preemption; the preempted request's pages park
+    on the LRU, so its recompute-style resume re-matches them — and every
+    output stays token-identical."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(1, vocab, int(n)) for n in (17, 21, 14)]
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=3, block_size=8, num_blocks=7, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    rids = [srv.submit(p, max_new_tokens=10) for p in prompts]
+    outs = srv.run()
+    assert srv.metrics.preemptions > 0, "pool sized to force preemption"
+    for p, rid in zip(prompts, rids):
+        assert outs[rid].tokens == _reference(llama_engine, p, 10)
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+    assert srv.compile_counts["prefill"] == 0
+
+
+def test_eviction_churn_many_distinct_prompts(llama_engine):
+    """More distinct prompts than the pool can cache: the LRU must evict
+    (counter moves), everything still finishes, zero leaks, and fresh
+    traffic still gets served from whatever stayed cached."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(11)
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=10, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    for i in range(8):
+        srv.submit(rs.randint(1, vocab, 20 + (i % 3) * 8), max_new_tokens=3)
+        srv.run()
+    assert srv.metrics.prefix_evictions > 0
+    assert all(r.done for r in srv._requests.values())
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+
+
+def test_headroom_gate_charges_uncached_suffix_for_shared_prefix(
+        srv_pc, llama_engine):
+    """KV-headroom admission: a prompt whose prefix is RESIDENT (pages
+    referenced by a running request) is charged only its uncached suffix
+    — those pages are already in used_count — so the cache hit passes a
+    gate the same-size cold prompt fails. Matched pages sitting idle on
+    the refcount-0 LRU are charged too (pinning them consumes allocatable
+    headroom exactly like a fresh allocation), so the discount applies
+    precisely when sharing is real."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(13)
+    prefix = rs.randint(1, vocab, 32)            # 4 full blocks
+    # holder keeps the prefix pages REFERENCED while it slowly decodes
+    holder = srv_pc.submit(np.concatenate([prefix,
+                                           rs.randint(1, vocab, 1)]),
+                           max_new_tokens=20)
+    for _ in range(3):
+        srv_pc.step()                             # admitted + prefilling
+    assert srv_pc.poll(holder).state == "running"
+    used = srv_pc.block_pool.used_count
+    cfg = srv_pc.config
+    old = cfg.kv_headroom_blocks
+    # budget = used + 2: the 5-block cold demand is rejected, the hot
+    # prompt (4 blocks shared with the holder + 1 new suffix) is admitted
+    cfg.kv_headroom_blocks = cfg.num_blocks - (used + 2)
+    try:
+        cold = rs.randint(1, vocab, 33)
+        assert srv_pc.try_submit(cold, max_new_tokens=2) is None
+        rid = srv_pc.try_submit(
+            np.concatenate([prefix, rs.randint(1, vocab, 1)]),
+            max_new_tokens=2)
+        assert rid is not None
+    finally:
+        cfg.kv_headroom_blocks = old
+    srv_pc.run()
+    assert srv_pc.poll(rid).state == "finished"
+    assert srv_pc.poll(holder).state == "finished"
+
+
+def test_headroom_gate_charges_pinning_idle_cached_pages(llama_engine):
+    """The other half of the admission-charge rule: matching pages that
+    sit refcount-0 on the LRU does NOT discount the charge — admission
+    would pin them (un-evictable), consuming allocatable headroom like a
+    fresh allocation — so a hit against an idle cache is charged like a
+    cold prompt and the gate's decode-growth reserve survives."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(15)
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    hot = rs.randint(1, vocab, 33)               # 4 full blocks + 1
+    srv.submit(hot, max_new_tokens=2)
+    srv.run()                                     # 4+ blocks now IDLE cached
+    srv.config.kv_headroom_blocks = srv.config.num_blocks - 2  # budget 2
+    # 4 pinned + 1 suffix = 5 > 2: rejected despite the full cache hit
+    assert srv.try_submit(hot, max_new_tokens=2) is None
+    assert srv.metrics.requests_rejected >= 1
+
+
+def test_chaos_storm_prefix_cache_no_leaks_no_stranded_blocks(llama_engine,
+                                                              monkeypatch):
+    """The chaos invariant, prefix-cache edition: a probabilistic fault
+    storm (flaky prefill / NaN logits / slow steps) over shared-prefix
+    traffic leaves every request terminal, ZERO leaked pages AND zero
+    stranded-cached pages (every cached page stays reachable through the
+    hash index — check_consistent raises otherwise), and fresh traffic
+    afterwards still completes with cache hits."""
+    from deepspeed_tpu.utils import fault_injection
+
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(17)
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16, step_watchdog_s=0.4))
+    prefix = rs.randint(1, vocab, 16)
+    warm = srv.submit(np.concatenate([prefix, rs.randint(1, vocab, 3)]),
+                      max_new_tokens=2)
+    srv.run()
+    assert srv.poll(warm).state == "finished"
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "flaky_prefill:p=0.3,corrupt_logits:p=0.15,"
+                       "slow_step:p=0.2:seconds=0.02")
+    fault_injection.reset()
+    try:
+        rids = [srv.submit(np.concatenate([prefix,
+                                           rs.randint(1, vocab, 4)]),
+                           max_new_tokens=3) for _ in range(10)]
+        steps = 0
+        while srv.has_work():
+            srv.step()
+            steps += 1
+            assert steps < 400, "engine wedged under chaos"
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert all(srv.poll(r).state in ("finished", "failed") for r in rids)
+    srv.block_pool.check_consistent()   # zero stranded-cached is in here
+    assert srv.block_pool.used_count == 0
+    # recovery with the cache still warm
+    cached_before = srv.metrics.cached_prefill_tokens
+    r = srv.submit(np.concatenate([prefix, rs.randint(1, vocab, 5)]),
+                   max_new_tokens=2)
+    srv.run()
+    assert srv.poll(r).state == "finished"
+    assert srv.metrics.cached_prefill_tokens > cached_before
+    assert srv.compile_counts == {"decode": 1, "prefill": 0,
+                                  "chunked_prefill": 1}
+
+
+def test_poisoned_prefill_never_enters_the_cache(llama_engine, monkeypatch):
+    """The logit guard runs BEFORE content indexing: a chunk whose logits
+    go NaN quarantines the request and its pages BLANK on release — the
+    next identical prompt must get zero hits and clean recomputed
+    tokens, never the poisoned KV."""
+    from deepspeed_tpu.utils import fault_injection
+
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(29)
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    prompt = rs.randint(1, vocab, 20)           # 2 full blocks + tail
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "corrupt_logits:tag=serving_prefill:fails=1")
+    fault_injection.reset()
+    try:
+        bad = srv.submit(prompt, max_new_tokens=4)
+        srv.run()
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    o = srv.poll(bad)
+    assert o.state == "failed" and o.finish_reason == "corrupt_logits"
+    assert srv.metrics.logit_quarantines == 1
+    assert srv.block_pool.cached_count == 0     # nothing indexed, all blank
+    srv.block_pool.check_consistent()
+    # the same prompt now recomputes from scratch and matches the
+    # uncached reference exactly
+    rid = srv.submit(prompt, max_new_tokens=4)
+    srv.run()
+    assert srv.metrics.prefix_hits == 0
+    assert srv.poll(rid).tokens == _reference(llama_engine, prompt, 4)
+
+
+def test_wedged_prefill_chunk_trips_watchdog_keeps_serving(llama_engine,
+                                                          monkeypatch):
+    """The step watchdog bounds the chunked-prefill program exactly like
+    decode: a wedged chunk fails ITS request (reason step_watchdog), the
+    same step's decode stays off the wedged backend, and the engine keeps
+    serving once the wedge clears."""
+    import time
+
+    from deepspeed_tpu.utils import fault_injection
+
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16, step_watchdog_s=0.3))
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(31)
+    warm = srv.submit(rs.randint(1, vocab, 9), max_new_tokens=2)
+    srv.run()                         # first chunk+decode carry the compiles
+    assert srv.poll(warm).state == "finished"
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "slow_chunk:seconds=1.0:fails=1")
+    fault_injection.reset()
+    try:
+        bad = srv.submit(rs.randint(1, vocab, 9), max_new_tokens=2)
+        t0 = time.perf_counter()
+        steps = 0
+        while srv.has_work():
+            srv.step()
+            steps += 1
+            assert steps < 400, "engine wedged"
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    o = srv.poll(bad)
+    assert o.state == "failed" and o.finish_reason == "step_watchdog"
+    assert srv.metrics.watchdog_trips == 1
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+    # wait out the abandoned call, then fresh traffic completes
+    while srv._wedged is not None and srv._wedged.is_alive():
+        time.sleep(0.05)
+    ok = srv.submit(rs.randint(1, vocab, 9), max_new_tokens=2)
+    steps = 0
+    while srv.has_work():
+        srv.step()
+        steps += 1
+        assert steps < 400
+    assert srv.poll(ok).state == "finished"
+    assert srv.compile_counts["chunked_prefill"] == 1  # no recompiles
+
+
+def test_negative_chunk_knobs_rejected_at_construction(llama_engine):
+    """A negative prefill budget would be truthy and silently disable
+    chunking — requests would sit 'prefilling' forever. Rejected at
+    construction like the other knobs."""
+    with pytest.raises(ValueError, match="prefill_token_budget"):
+        ServingEngine(llama_engine, ServingConfig(
+            prefix_cache=True, prefill_token_budget=-1))
+    with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+        ServingEngine(llama_engine, ServingConfig(prefill_chunk_tokens=-8))
+
+
+def test_metrics_snapshot_exports_prefix_counters(srv_pc):
+    snap = srv_pc.metrics.snapshot()
+    for key in ("prefix_hit_rate", "cached_prefill_tokens",
+                "prefill_tokens_computed", "prefix_evictions",
+                "kv_blocks_cached", "cow_copies", "served_tokens",
+                "chunked_prefill_waiting", "chunked_prefill_queue_age_s"):
+        assert key in snap, key
+    assert snap["served_tokens"] >= snap["tokens_generated"]
+
+
+@pytest.mark.slow
+def test_chunked_prefill_without_prefix_cache_parity(llama_engine):
+    """Chunked prefill alone (no caching): still token-identical, still
+    one chunked-prefill compile, zero bucketed prefills."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(19)
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefill_chunk_tokens=8))
+    prompts = [rs.randint(1, vocab, int(n)) for n in (19, 30, 7)]
+    rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+    outs = srv.run()
+    for p, rid in zip(prompts, rids):
+        assert outs[rid].tokens == _reference(llama_engine, p, 5)
+    assert srv.compile_counts == {"decode": 1, "prefill": 0,
+                                  "chunked_prefill": 1}
+    assert srv.metrics.cached_prefill_tokens == 0  # caching stayed off
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+
+
+@pytest.mark.slow
+def test_gpt2_prefix_cache_parity():
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    rs = np.random.RandomState(21)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ds.init_inference(model, params=params, dtype="fp32")
+    srv = ServingEngine(eng, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    prefix = rs.randint(1, cfg.vocab_size, 18)
+    prompts = [np.concatenate([prefix, rs.randint(1, cfg.vocab_size, t)])
+               for t in (3, 6)]
+    # sequential so the second prompt finds the first's pages cached
+    outs = {}
+    for p in prompts:
+        rid = srv.submit(p, max_new_tokens=4)
+        srv.run()
+        outs[rid] = srv.poll(rid)
+        ref = [int(t) for t in np.asarray(eng.generate(
+            np.asarray(p)[None], max_new_tokens=4, do_sample=False))[0]]
+        assert outs[rid].tokens == ref
+    assert srv.metrics.prefix_hits >= 1
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+
+
+@pytest.mark.slow
+def test_int8_pool_prefix_cache_close_to_dense_int8():
+    """kv_cache_int8 + prefix caching: reused pages carry the SAME int8
+    codes the original prefill wrote, so greedy agreement with the dense
+    int8 engine stays high (identical quantization granularity)."""
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(23)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    eng8 = ds.init_inference(model, params=params, dtype="fp32",
+                             kv_cache_int8=True)
+    srv = ServingEngine(eng8, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16))
+    prompt = rs.randint(1, cfg.vocab_size, 19)
+    for _ in range(2):                  # second pass rides the cache
+        rid = srv.submit(prompt, max_new_tokens=6)
+        srv.run()
+        got = srv.poll(rid).tokens
+        ref = np.asarray(eng8.generate(np.asarray(prompt)[None],
+                                       max_new_tokens=6,
+                                       do_sample=False))[0]
+        agree = np.mean(np.asarray(got) == ref)
+        assert agree >= 0.8, f"int8 prefix serving diverged: {agree}"
+    assert srv.metrics.prefix_hits >= 1
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0

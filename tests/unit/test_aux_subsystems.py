@@ -70,6 +70,7 @@ def test_elastic_conflicts_with_explicit_batch():
     assert resolved.train_batch_size == 16  # elastic plan wins
 
 
+@pytest.mark.slow
 def test_elastic_engine_batch_triangle():
     cfg = LlamaConfig.tiny(remat=False)
     model = LlamaForCausalLM(cfg)
@@ -117,6 +118,7 @@ def test_curriculum_schedules():
         [8, 8, 16, 16, 32, 32]
 
 
+@pytest.mark.slow
 def test_curriculum_engine_truncates_batch():
     cfg = LlamaConfig.tiny(remat=False)
     model = LlamaForCausalLM(cfg)
@@ -156,6 +158,7 @@ def test_pld_theta_schedule():
     assert all(a >= b for a, b in zip(ts, ts[1:]))
 
 
+@pytest.mark.slow
 def test_pld_changes_training_and_stays_finite():
     cfg = LlamaConfig.tiny(remat=False)
     model = LlamaForCausalLM(cfg)
@@ -205,6 +208,7 @@ def test_profile_fn_counts_matmuls_exactly():
     assert tree.total_flops() == 2 * 32 * 128 * 64 + 32 * 128
 
 
+@pytest.mark.slow
 def test_profile_scanned_model_multiplies_layers():
     from deepspeed_tpu.profiling import get_model_profile
 
@@ -238,6 +242,7 @@ def test_engine_flops_profiler_hook(capsys):
     assert prof.get_total_flops() > 2 * 2 * n * toks
 
 
+@pytest.mark.slow
 def test_schedules_resume_from_checkpoint(tmp_path):
     """Curriculum/PLD/MoQ schedules are pure functions of the step counters,
     so save -> fresh engine -> load resumes them exactly (reference

@@ -80,6 +80,7 @@ def test_model_level_parity(tied):
         assert np.allclose(leaf, flat1[path], rtol=1e-4, atol=1e-5), path
 
 
+@pytest.mark.slow
 def test_generic_transformer_chunked_trains():
     cfg = TransformerConfig(vocab_size=97, hidden_size=24,
                             intermediate_size=48, num_hidden_layers=2,
@@ -100,6 +101,7 @@ def test_generic_transformer_chunked_trains():
     assert logits.shape == (2, 12, 97)
 
 
+@pytest.mark.slow
 def test_gpt2_chunked_parity():
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 

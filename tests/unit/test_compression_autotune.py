@@ -249,6 +249,7 @@ def test_embedding_token_wise_quantization():
         assert rel.max() < 0.1, (row, rel.max())
 
 
+@pytest.mark.slow
 def test_activation_quantization_trains_and_quantizes():
     """activation_quantization fake-quants matched modules' inputs inside
     the compiled step; training still converges (reference

@@ -40,7 +40,8 @@ def _tiny_llama_hf(seed=0):
 
 
 @pytest.mark.parametrize("family", ["llama", "gpt2"])
-@pytest.mark.parametrize("scan_layers", [True, False])
+@pytest.mark.parametrize("scan_layers", [
+    pytest.param(True, marks=pytest.mark.slow), False])
 def test_cached_decode_matches_full_forward(family, scan_layers):
     if family == "llama":
         from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -156,7 +157,10 @@ def test_inference_tensor_parallel_matches_single():
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.parallel import build_mesh
 
-    cfg = LlamaConfig.tiny(remat=False)
+    # Hkv=4 so mp_size=4 divides the kv heads: this test pins TP MECHANICS
+    # (sharded generate == single-device); mp > Hkv is rejected outright by
+    # the engine's TP/GQA guard (see test_tp_numerics.py)
+    cfg = LlamaConfig.tiny(remat=False, num_key_value_heads=4)
     model = LlamaForCausalLM(cfg)
     ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
     params = model.init(jax.random.PRNGKey(0), ids)["params"]
@@ -517,6 +521,7 @@ def test_mistral_sliding_window_parity_and_generate():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow
 def test_qwen2_logits_and_generate_parity():
     """Qwen2 = Llama graph + QKV biases; tied-embedding variant included."""
     torch = pytest.importorskip("torch")
@@ -678,6 +683,7 @@ def test_gemma_logits_and_generate_parity():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow
 def test_int8_dequant_per_step_exact_match():
     """dequant_per_step only moves WHERE dequantization happens (inside the
     decode loop, behind an optimization barrier) — generated tokens must be
@@ -698,6 +704,7 @@ def test_int8_dequant_per_step_exact_match():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_int8_kv_cache_composes_with_tensor_parallel():
     """kv_cache_int8 under mp_size=4: scales [B,S,Hkv] shard with the cache
     over the head axis; greedy tokens must match the single-device int8-cache
@@ -706,7 +713,8 @@ def test_int8_kv_cache_composes_with_tensor_parallel():
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.parallel import build_mesh
 
-    cfg = LlamaConfig.tiny(remat=False)
+    # Hkv=4: mp_size=4 | kv heads (the engine rejects mp > Hkv)
+    cfg = LlamaConfig.tiny(remat=False, num_key_value_heads=4)
     model = LlamaForCausalLM(cfg)
     ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size,
                                                        (2, 8)))
@@ -721,6 +729,7 @@ def test_int8_kv_cache_composes_with_tensor_parallel():
     np.testing.assert_array_equal(out1, out2)
 
 
+@pytest.mark.slow
 def test_quantize_on_ambient_expert_mesh_still_allowed():
     """A leftover training mesh with an expert axis must not block int8
     serving when the user did not request EP (ep_size defaults to 1:
@@ -864,6 +873,7 @@ def test_prefill_flash_from_empty_generates_identically():
     np.testing.assert_array_equal(got, base)
 
 
+@pytest.mark.slow
 def test_prefill_flash_gpt2_generates_identically():
     """GPT-2's prefill_flash_from_empty path: greedy tokens equal the XLA
     cached-prefill path, including a left-padded prompt."""
@@ -892,7 +902,8 @@ def test_prefill_flash_gpt2_generates_identically():
     np.testing.assert_array_equal(got, base)
 
 
-@pytest.mark.parametrize("family", ["opt", "gpt_neox"])
+@pytest.mark.parametrize("family", [
+    "opt", pytest.param("gpt_neox", marks=pytest.mark.slow)])
 def test_prefill_flash_generic_families(family):
     """Generic-transformer prefill_flash_from_empty: greedy parity with the
     XLA cached path (eligible families; left-padded prompt included)."""
@@ -921,6 +932,7 @@ def test_prefill_flash_generic_families(family):
     np.testing.assert_array_equal(got, base)
 
 
+@pytest.mark.slow
 def test_prefill_flash_ineligible_alibi_stays_on_xla():
     """BLOOM (alibi) must not take the flash prefill path even when the
     flag is set — eligibility is static and output stays correct."""

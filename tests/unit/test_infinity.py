@@ -69,6 +69,7 @@ class TestInfinity:
         losses = [float(engine.train_batch(b)) for _ in range(8)]
         assert losses[-1] < losses[0] - 0.5, losses
 
+    @pytest.mark.slow
     def test_gradients_match_dense_execution(self):
         """Block streaming + per-block vjp must produce the same step as a
         dense whole-model gradient (same bf16 compute, same host optimizer).
@@ -119,6 +120,7 @@ class TestInfinity:
                 lambda a, r: np.testing.assert_allclose(a, r, atol=1e-2),
                 got, ref)
 
+    @pytest.mark.slow
     def test_device_working_set_bounded(self):
         """The capability claim: peak bytes ALLOCATED DURING THE STEP
         (identity-excluded vs a gc'd step-entry baseline — live_arrays()
@@ -153,6 +155,7 @@ class TestInfinity:
             ds.initialize(model=_module(), config=_cfg(),
                           example_batch=_batch(), mesh=mesh)
 
+    @pytest.mark.slow
     def test_gradient_accumulation_matches_single_batch(self):
         """gas=2 over a 16-row batch must step identically to gas=1 over the
         same 16 rows (equal-size micro-batches ⇒ mean of micro-grads equals
@@ -178,6 +181,7 @@ class TestInfinity:
                     np.asarray(x, np.float32), np.asarray(y, np.float32),
                     atol=2e-2), a, b)
 
+    @pytest.mark.slow
     def test_gas_data_iter_consumes_gas_micro_batches(self):
         """From an iterator the engine must pull gas MICRO-batches per step
         (reference train_batch semantics; the dataloader yields micro*dp
@@ -210,6 +214,7 @@ class TestInfinity:
                     np.asarray(x, np.float32), np.asarray(y, np.float32)),
                 a, b)
 
+    @pytest.mark.slow
     def test_dp2_sharded_streaming_matches_single_device(self):
         """With a 2-device 'data' mesh the streamed blocks are ZeRO-3
         flat-sharded (H2D per shard + all-gather in the block fn) and grads
@@ -237,6 +242,7 @@ class TestInfinity:
                     np.asarray(x, np.float32), np.asarray(y, np.float32),
                     atol=4e-2), a, b)
 
+    @pytest.mark.slow
     def test_checkpoint_roundtrip(self, tmp_path):
         engine, *_ = ds.initialize(model=_module(layers=4),
                                    config=_cfg(block_layers=2),
@@ -264,6 +270,7 @@ class TestInfinity:
         lb = float(fresh.train_batch(_batch(seed=3)))
         assert abs(la - lb) < 1e-3
 
+    @pytest.mark.slow
     def test_lr_scheduler_applies(self):
         cfg = _cfg(block_layers=2)
         cfg["scheduler"] = {"type": "WarmupLR",
@@ -279,6 +286,7 @@ class TestInfinity:
         engine.train_batch(_batch())
         assert engine._host_opt.current_lr() > lr0  # warming up
 
+    @pytest.mark.slow
     def test_nvme_body_memmap_streams_and_roundtrips(self, tmp_path):
         """``offload_param.device == "nvme"`` (r4): the streamed BODY lives
         in memory-mapped files — model size bounded by disk, the reference
@@ -320,6 +328,7 @@ class TestInfinity:
                     np.asarray(a, np.float32), np.asarray(r, np.float32)),
                 got, ref)
 
+    @pytest.mark.slow
     def test_nvme_body_composes_with_dp(self, tmp_path):
         """nvme body x dp: the FLAT shard staging itself is memmap-backed
         (host_blocks are views of the maps), so dp sharding does not pull
@@ -341,6 +350,7 @@ class TestInfinity:
         losses = [float(engine.train_batch(b)) for _ in range(4)]
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow
     def test_full_nvme_masters_and_grads_disk_backed(self, tmp_path):
         """Full ZeRO-Infinity disk residency (r4): with body nvme +
         offload_optimizer nvme, EVERY O(model) array is disk-backed — bf16
@@ -385,6 +395,7 @@ class TestInfinity:
         lb = float(fresh.train_batch(_batch(seed=3)))
         assert abs(la - lb) < 1e-3
 
+    @pytest.mark.slow
     def test_nvme_moments_compose(self, tmp_path):
         """offload_param nvme BODY + offload_optimizer nvme MOMENTS: the
         full ZeRO-Infinity disk-resident working set (params + optimizer
@@ -401,6 +412,7 @@ class TestInfinity:
         assert losses[-1] < losses[0], losses
         assert any(p.name.startswith("moment") for p in tmp_path.iterdir())
 
+    @pytest.mark.slow
     def test_elastic_auto_save_and_resume(self, tmp_path, monkeypatch):
         """Under the elastic agent (DS_ELASTIC_CHECKPOINT_DIR set) the
         Infinity engine auto-saves every save_interval and a fresh

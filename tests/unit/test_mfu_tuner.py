@@ -3,6 +3,7 @@
 memoization and cost-model-guided in-axis ordering/pruning."""
 
 import numpy as np
+import pytest
 
 import deepspeed_tpu as ds  # noqa: F401 (mesh/conftest setup)
 from deepspeed_tpu.autotuning import MFUTuner
@@ -75,6 +76,7 @@ def test_descent_reproduces_bruteforce_best_with_fewer_evals(tmp_path):
     assert spec_key(best2["spec"]) == spec_key(brute)
 
 
+@pytest.mark.slow
 def test_tune_mfu_inprocess_on_cpu_mesh(tmp_path):
     """Autotuner.tune_mfu measures real engines on the mesh and returns a
     directly-usable (model_config, ds_config) pair for the winner."""

@@ -49,6 +49,7 @@ def test_generate_matches_hf_greedy():
     np.testing.assert_array_equal(ours, ref)
 
 
+@pytest.mark.slow
 def test_training_converges_with_expert_parallelism():
     """Expert weights shard over the ``expert`` mesh axis; training through
     the engine converges and the router aux loss is finite."""
@@ -76,6 +77,7 @@ def test_training_converges_with_expert_parallelism():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_forward():
     from deepspeed_tpu.models import MixtralConfig, MixtralForCausalLM
 
@@ -186,6 +188,7 @@ print("REPLICATE-OK", losses[0], losses[-1])
 """
 
 
+@pytest.mark.slow
 def test_replicate_tokens_ep_layout_trains():
     """``{"moe": {"replicate_tokens": true}}``: tokens shard over `data`
     only (replicated across the expert axis) so the MoE block needs NO

@@ -104,6 +104,7 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(cont2, cont1, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_fp16_offload_trains_and_scales():
     """fp16 x offload_optimizer (r4, the reference's DEFAULT offload mode,
     stage_1_and_2.py:1027-1178): scaled grads leave the device, the host

@@ -54,8 +54,10 @@ def test_zero_one_adam_variance_interval():
     # freeze_step must leave enough warmup for the variance to establish
     # (freezing after a handful of steps diverges — true of the reference
     # algorithm as well, which freezes ~1/4 into training)
-    ("OneBitAdam", {"lr": 3e-3, "freeze_step": 8}),
-    ("OneBitLamb", {"lr": 3e-3, "freeze_step": 8}),
+    pytest.param("OneBitAdam", {"lr": 3e-3, "freeze_step": 8},
+                 marks=pytest.mark.slow),
+    pytest.param("OneBitLamb", {"lr": 3e-3, "freeze_step": 8},
+                 marks=pytest.mark.slow),
     # 0/1 Adam compresses from step one; the variance freeze comes late in
     # training (reference default 100k), so don't freeze inside the test
     ("ZeroOneAdam", {"lr": 3e-3, "var_freeze_step": 1000}),

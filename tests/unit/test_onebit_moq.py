@@ -63,6 +63,7 @@ def test_compressed_allreduce_error_feedback_converges():
     assert np.abs(werr2).max() > 0  # compression really was lossy
 
 
+@pytest.mark.slow
 def test_compressed_allreduce_repeated_rounds_track_mean():
     """With error feedback, REPEATED rounds on the same inputs accumulate to
     the true mean (the EF-SGD convergence property the reference relies on)."""
@@ -191,6 +192,7 @@ def test_moq_quantize_tree_reduces_distinct_values():
     assert q.quantize_tree({"b": b}, 0)["b"] is b
 
 
+@pytest.mark.slow
 def test_moq_engine_training_applies_schedule():
     """The flag observably changes training: with an immediate aggressive
     schedule, the loss trajectory differs from baseline and weights used in
@@ -237,6 +239,7 @@ def test_eigenvalue_power_iteration_quadratic():
     assert eig == pytest.approx(9.0, rel=1e-2)
 
 
+@pytest.mark.slow
 def test_eigenvalue_on_model_loss_is_finite():
     from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
 
@@ -250,6 +253,7 @@ def test_eigenvalue_on_model_loss_is_finite():
     assert np.isfinite(eig) and eig > 0
 
 
+@pytest.mark.slow
 def test_onebit_wire_with_gradient_accumulation():
     """gas > 1 composes with the wire path (r3: local grads accumulate over
     microbatches, ONE compressed exchange per optimizer step)."""
@@ -275,6 +279,7 @@ def test_onebit_wire_with_gradient_accumulation():
     assert "compressed_allreduce" in comms_logger.comms_dict
 
 
+@pytest.mark.slow
 def test_onebit_wire_fp16_trains_and_skips_on_overflow():
     """r4: fp16 composes with the compressed wire — the local loss is
     scaled before backward, scaled grads unscale + overflow-check globally

@@ -282,6 +282,7 @@ def test_pipeline_module_partitioning_validation():
     assert len(pipe.prefix_specs) == 1 and len(pipe.suffix_specs) == 1
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_tensor_parallel():
     """pipe=2 x model=2 (x data=2): body Dense kernels sharded over the
     ``model`` axis ride shard_map's AUTO axes while the ring is manual —
@@ -324,6 +325,7 @@ def test_pipeline_composes_with_tensor_parallel():
     np.testing.assert_allclose(float(l_pipe), l_seq, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_flops_not_inflated_by_suffix():
     """Per-device FLOPs of the pipelined loss must not exceed sequential
     execution of the same global batch: the suffix (vocab projection — the
@@ -365,6 +367,7 @@ def test_pipeline_flops_not_inflated_by_suffix():
     assert pipe_flops < seq_flops * 1.05, (pipe_flops, seq_flops)
 
 
+@pytest.mark.slow
 def test_pipeline_engine_trains_with_tensor_parallel():
     """Full engine path for pipe=2 x model=2 x data=2 with ZeRO-1 + bf16
     (exercises the partial-manual shard_map under jit with in_shardings)."""
@@ -396,6 +399,7 @@ def test_pipeline_engine_trains_with_tensor_parallel():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_time_checkpoint_chunk_matches_plain_scan():
     """Chunked-remat time scan (1F1B-class memory bound) is numerically
     identical to the plain scan — same loss trajectory, same params."""
@@ -459,6 +463,7 @@ class SelfAttnBlock(nn.Module):
         return x + nn.Dense(self.hidden, name="proj")(out.reshape(B, T, self.hidden))
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_sequence_parallel():
     """pipe=2 x seq=2 (x data=2): Ulysses attention reshards over the AUTO
     ``seq`` axis inside the manual pipe ring — parity vs sequential
@@ -602,6 +607,7 @@ def test_1f1b_engine_trains_with_dp_and_tied():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_1f1b_composes_with_sequence_parallel():
     """pipe=2 x seq=2 x data=2 under 1F1B: Ulysses reshards over the AUTO
     seq axis inside the manual-grad scan; exact parity vs sequential."""
@@ -650,6 +656,7 @@ def test_1f1b_composes_with_sequence_parallel():
         topology.set_mesh(None, None)
 
 
+@pytest.mark.slow
 def test_1f1b_composes_with_tensor_parallel():
     """pipe=2 x model=2 x data=2 under the interleaved 1F1B schedule: the
     model axis stays AUTO inside the manual-grad scan (TP psums inserted by
@@ -703,6 +710,7 @@ def test_1f1b_composes_with_tensor_parallel():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_engine_trains_with_tp_and_bf16():
     """The engine-level lifted combination the compat matrix advertises:
     schedule='1f1b' x model=2 x data=2 with the in-spmd bf16 cast of
@@ -733,7 +741,10 @@ def test_1f1b_engine_trains_with_tp_and_bf16():
     assert losses[-1] < losses[0], losses
 
 
-@pytest.mark.parametrize("stages,micro", [(8, 2), (2, 8), (4, 3)])
+@pytest.mark.parametrize("stages,micro", [
+    pytest.param(8, 2, marks=pytest.mark.slow),
+    pytest.param(2, 8, marks=pytest.mark.slow),
+    (4, 3)])
 def test_1f1b_parity_at_schedule_extremes(stages, micro):
     """M < S (more stages than microbatches — the warmup/cooldown-only
     regime), M >> S, and a non-divisible M/S ratio must all produce exact

@@ -24,6 +24,7 @@ def _plain(q, k, v, causal):
     return dot_product_attention(q, k, v, causal=causal, attention_impl="xla")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_plain(causal):
     from deepspeed_tpu.parallel import build_mesh, set_mesh
@@ -38,6 +39,7 @@ def test_ring_attention_matches_plain(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_backward_matches_plain():
     from deepspeed_tpu.parallel import build_mesh, set_mesh
     from deepspeed_tpu.sequence import ring_attention
@@ -68,6 +70,7 @@ def test_ulysses_attention_matches_plain(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_flash_matches_plain(causal):
     """The shard_map Ulysses (explicit all_to_all swap + flash core per
@@ -150,6 +153,7 @@ def test_llama_trains_with_sequence_parallelism(impl):
     assert losses_sp[-1] < losses_sp[0]
 
 
+@pytest.mark.slow
 def test_ulysses_flash_sliding_window_parity():
     """cfg.sliding_window threads through the all_to_all swap: post-swap
     each shard holds the full sequence, so the kernel's global window is
@@ -169,6 +173,7 @@ def test_ulysses_flash_sliding_window_parity():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_composes_with_tensor_parallel():
     """r4 (lifting the r3 refusal): with tp > 1 the shard_map goes manual
     over (seq, model) — heads shard explicitly over TP, the flash kernel

@@ -28,7 +28,8 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 from deepspeed_tpu.parallel import build_mesh
 
-pytestmark = pytest.mark.slow
+# the multi-shard forward comparisons are slow-tier; the init-time guard
+# test stays in tier-1 so a silent revert of the hard reject can't pass CI
 
 #: reduction-order noise bound for divisible TP on the fp32 tiny model
 #: (measured ~1.5e-6; 1e-4 leaves margin for XLA version drift)
@@ -60,6 +61,7 @@ def _setup(**cfg_over):
     return cfg, params, prompt
 
 
+@pytest.mark.slow
 def test_tp_divisible_kv_heads_matches_single_device():
     """mp=2 divides Hkv=2: TP-vs-single difference is pure reduction
     order, ~1e-6 — NOT the ~1.35 the old open item attributed to it."""
@@ -71,6 +73,7 @@ def test_tp_divisible_kv_heads_matches_single_device():
     assert (single.argmax(-1) == tp2.argmax(-1)).all()  # greedy identical
 
 
+@pytest.mark.slow
 def test_tp4_mha_matches_single_device():
     """mp=4 with Hkv=4 (no GQA split): also exact to reduction order —
     the divergence is NOT a property of mp=4 itself."""
@@ -82,20 +85,46 @@ def test_tp4_mha_matches_single_device():
     assert (single.argmax(-1) == tp4.argmax(-1)).all()
 
 
+@pytest.mark.slow
 def test_tp4_gqa_head_split_divergence_pinned():
     """mp=4 over Hkv=2 splits kv heads across shards: the SPMD-partitioned
     repeat_kv mis-computes and logits diverge. Pin the current bound: a
     FAIL below the band means the stack got fixed (tighten to
-    DIVISIBLE_TP_TOL and drop the init-time warning); above means it got
-    even worse."""
+    DIVISIBLE_TP_TOL and drop the init-time guard); above means it got
+    even worse. ``allow_unsafe_tp=True`` is exactly for this repro — the
+    engine hard-rejects the config otherwise."""
     cfg, params, prompt = _setup()  # tiny default: Hkv=2
     assert cfg.num_key_value_heads == 2
     single = _logits(cfg, params, prompt)
-    tp4 = _logits(cfg, params, prompt, mp_size=4,
+    tp4 = _logits(cfg, params, prompt, mp_size=4, allow_unsafe_tp=True,
                   mesh=build_mesh(data=2, model=4))
     d = np.abs(single - tp4).max()
     assert KNOWN_DIVERGENCE_LO < d < KNOWN_DIVERGENCE_HI, (
         f"mp=4/Hkv=2 divergence moved out of its pinned band: {d:.4g} "
         f"(band {KNOWN_DIVERGENCE_LO}..{KNOWN_DIVERGENCE_HI}); if it "
         f"shrank below the band the partitioner bug is fixed — tighten "
-        f"this test and remove the engine warning")
+        f"this test and remove the engine guard")
+
+
+def test_tp_beyond_kv_heads_hard_rejected():
+    """The proven-wrong case is a hard REJECT at init, not a warning: a
+    silently-wrong forward must be impossible to reach by accident. The
+    error names the kv-head-replication workaround; allow_unsafe_tp=True
+    is the only way through (pinned above)."""
+    from deepspeed_tpu.parallel import topology
+
+    cfg, params, prompt = _setup()  # tiny default: Hkv=2
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+    with pytest.raises(ValueError, match="replicate kv heads"):
+        ds.init_inference(LlamaForCausalLM(cfg), params=params, dtype="fp32",
+                          mp_size=4, mesh=build_mesh(data=2, model=4))
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+    # mp_size=2 divides Hkv=2: still admitted, no escape hatch needed
+    eng = ds.init_inference(LlamaForCausalLM(cfg), params=params,
+                            dtype="fp32", mp_size=2,
+                            mesh=build_mesh(data=4, model=2))
+    assert eng.mp_world_size == 2
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None

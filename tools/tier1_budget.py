@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Tier-1 wall-time budget check (``tools/tier1_budget.py``).
+
+Tier-1 (``pytest -m "not slow"``) must finish inside its CI budget
+(default 870 s on the seed box). Wall time only shows up AFTER a slow run
+has already burned the budget, so this tool estimates it BEFORE running:
+it collects the current tier-1 test set and prices each file against a
+committed per-file timing manifest measured on the seed box
+(``tools/tier1_timings.json``). Files that grew tests scale up
+proportionally; files unknown to the manifest are priced at the measured
+suite-wide per-test average. Over budget -> exit 1 with the top
+offenders, so the PR that pushed tier-1 over pays the bill (by moving
+long parameterizations behind ``@pytest.mark.slow``), not whoever runs CI
+next.
+
+Usage:
+  python tools/tier1_budget.py                   # check against budget
+  python tools/tier1_budget.py --budget 870
+  python tools/tier1_budget.py --measure t1.log  # rebuild the manifest
+                                                 # from a `--durations=0`
+                                                 # tier-1 run log
+
+The manifest is an estimate, not an oracle: re-measure (one tier-1 run
+with ``--durations=0``, then ``--measure``) after hardware or suite-shape
+changes.
+"""
+
+import argparse
+import collections
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, "tools", "tier1_timings.json")
+#: the ROADMAP tier-1 verify timeout on the seed box
+DEFAULT_BUDGET_S = 870.0
+#: pytest work not attributed to any one test (collection, imports,
+#: session fixtures) — measured as (wall - sum of durations) on the seed
+OVERHEAD_KEY = "_session_overhead_s"
+DEFAULT_KEY = "_default_per_test_s"
+
+#: `--durations=0` line: "12.34s call     tests/unit/foo.py::test_x[...]"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+?)::")
+
+
+def collect_tier1(pytest_args=()):
+    """Node ids of the CURRENT tier-1 set (collect-only, no execution)."""
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+           "--collect-only", "-p", "no:cacheprovider",
+           "--continue-on-collection-errors", *pytest_args]
+    out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    nodes = [ln.strip() for ln in out.stdout.splitlines()
+             if "::" in ln and not ln.startswith(("=", "<", " "))]
+    if not nodes:
+        raise SystemExit(f"collected nothing; pytest said:\n{out.stdout[-2000:]}"
+                         f"\n{out.stderr[-2000:]}")
+    return nodes
+
+
+def per_file_counts(nodes):
+    counts = collections.Counter()
+    for n in nodes:
+        counts[n.split("::", 1)[0]] += 1
+    return counts
+
+
+def measure(log_path):
+    """Build the manifest from a tier-1 run log produced with
+    ``--durations=0``. Per-file seconds come from the durations lines;
+    per-file test COUNTS come from a fresh collection of the same
+    checkout — pytest hides sub-5ms phases even at ``--durations=0``, so
+    counting only tests with duration lines would undercount fast files
+    and inflate every future scaled estimate."""
+    secs = collections.defaultdict(float)
+    wall = None
+    with open(log_path, errors="replace") as f:
+        for line in f:
+            m = _DURATION_RE.match(line)
+            if m:
+                secs[m.group(3)] += float(m.group(1))
+            mw = re.search(r"in (\d+(?:\.\d+)?)s(?: \(|$)", line)
+            if mw:
+                wall = float(mw.group(1))
+    if not secs:
+        raise SystemExit(f"no `--durations=0` lines found in {log_path}; "
+                         f"run tier-1 with --durations=0 first")
+    counts = per_file_counts(collect_tier1())
+    total_attr = sum(secs.values())
+    total_tests = sum(counts.values())
+    # every collected file gets an entry — files with NO duration lines
+    # are genuinely sub-5ms-per-phase (pytest hides those even at
+    # --durations=0) and must be priced ~0, not at the suite average;
+    # only files unknown to the manifest (added later) take the default
+    manifest = {f: {"seconds": round(secs.get(f, 0.0), 2), "tests": n}
+                for f, n in sorted(counts.items())}
+    manifest[DEFAULT_KEY] = round(total_attr / max(1, total_tests), 3)
+    manifest[OVERHEAD_KEY] = round(max(0.0, (wall or total_attr)
+                                       - total_attr), 1)
+    with open(MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"wrote {MANIFEST}: {len(secs)} files, "
+          f"{total_attr:.0f}s attributed + "
+          f"{manifest[OVERHEAD_KEY]}s session overhead "
+          f"(wall {wall if wall is not None else 'unknown'}s)")
+    return manifest
+
+
+def check(budget, pytest_args=()):
+    if not os.path.exists(MANIFEST):
+        raise SystemExit(f"{MANIFEST} missing — run a tier-1 with "
+                         f"--durations=0 and then --measure <log>")
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+    default_per_test = manifest.get(DEFAULT_KEY, 1.0)
+    overhead = manifest.get(OVERHEAD_KEY, 0.0)
+    counts = per_file_counts(collect_tier1(pytest_args))
+    rows = []
+    for fname, n in counts.items():
+        entry = manifest.get(fname)
+        if entry and entry["tests"]:
+            est = entry["seconds"] * n / entry["tests"]
+            basis = "measured" if n == entry["tests"] else \
+                f"scaled x{n / entry['tests']:.2f}"
+        else:
+            est = default_per_test * n
+            basis = "default (new file)"
+        rows.append((est, fname, n, basis))
+    rows.sort(reverse=True)
+    total = sum(r[0] for r in rows) + overhead
+    print(f"tier-1 estimate: {total:.0f}s against a {budget:.0f}s budget "
+          f"({len(counts)} files, {sum(counts.values())} tests, "
+          f"{overhead}s session overhead)")
+    for est, fname, n, basis in rows[:12]:
+        print(f"  {est:7.1f}s  {fname}  ({n} tests, {basis})")
+    if total > budget:
+        print(f"OVER BUDGET by {total - budget:.0f}s: move the slowest "
+              f"non-core parameterizations behind @pytest.mark.slow (see "
+              f"the offenders above), then re-run; re-measure the "
+              f"manifest if the estimate looks stale.")
+        return 1
+    print("within budget")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
+    ap.add_argument("--measure", metavar="LOG", default=None,
+                    help="rebuild tools/tier1_timings.json from a tier-1 "
+                         "run log produced with --durations=0")
+    args, extra = ap.parse_known_args()
+    if args.measure:
+        measure(args.measure)
+        return 0
+    return check(args.budget, extra)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
