@@ -239,6 +239,23 @@ def last_verified_tag(save_dir: str,
     return None
 
 
+def _flight_verify_failure(save_dir: str, tag: Optional[str],
+                           detail: str) -> None:
+    """Post-mortem hook: a checkpoint that fails verification is an
+    incident worth evidence even when the load recovers via walk-back.
+    Dumps through the process-global flight recorder (armed by
+    ``DS_TRACE_DIR`` / ``monitor.tracing.configure``; no-op otherwise) —
+    this module has no engine handle, so the global default is the only
+    recorder it can reach. Never raises."""
+    try:
+        from ..monitor.tracing import flight_dump
+
+        flight_dump("checkpoint_verify",
+                    {"dir": save_dir, "tag": tag, "detail": detail})
+    except Exception:  # tracing must never break a checkpoint load
+        pass
+
+
 def resolve_load_tag(save_dir: str, tag: Optional[str] = None,
                      allow_fallback: bool = True) -> str:
     """Pick the tag a load should restore.
@@ -254,6 +271,7 @@ def resolve_load_tag(save_dir: str, tag: Optional[str] = None,
     if tag is not None:
         status, detail = verify_checkpoint(save_dir, tag)
         if status == "bad":
+            _flight_verify_failure(save_dir, tag, detail)
             raise CheckpointCorruptionError(
                 f"checkpoint {tag!r} in {save_dir} failed verification "
                 f"({detail}); refusing to load it. Newest verified save: "
@@ -275,10 +293,16 @@ def resolve_load_tag(save_dir: str, tag: Optional[str] = None,
                      f"({detail})" + ("; falling back to the newest "
                                       "verified save" if allow_fallback
                                       else ""))
+        # the walk-back SUCCEEDING still means a save was lost to
+        # corruption — leave a post-mortem even though the load recovers
+        _flight_verify_failure(save_dir, candidate, detail)
     else:
         logger.error(f"[checkpoint] no readable 'latest' tag in {save_dir}" +
                      ("; falling back to the newest verified save"
                       if allow_fallback else ""))
+        # saves exist (the fresh-dir case returned above) but the pointer
+        # is unreadable/torn — an incident, dump it like a bad manifest
+        _flight_verify_failure(save_dir, None, "no readable 'latest' tag")
     if allow_fallback:
         exclude = (candidate,) if candidate else ()
         fallback = last_verified_tag(save_dir, exclude=exclude)

@@ -87,6 +87,41 @@ def fault_report() -> None:
         print(f"  armed: {s.name} ({params})")
 
 
+def trace_report() -> None:
+    """Print tracing / flight-recorder status next to the DS_FAULT spec:
+    an incident doc that records which faults were armed should also say
+    where the post-mortems went (or that none were being captured)."""
+    from deepspeed_tpu.monitor import tracing
+
+    d = os.environ.get(tracing.ENV_TRACE_DIR)
+    if not d:
+        print(f"tracing ({tracing.ENV_TRACE_DIR}): disabled — no trace "
+              f"ring, no flight recorder (set {tracing.ENV_TRACE_DIR}="
+              f"/path to arm both)")
+        return
+    print(f"tracing ({tracing.ENV_TRACE_DIR}): armed -> {d}")
+    if not os.path.isdir(d):
+        print("  (directory not created yet; appears on first dump)")
+        return
+    # newest by mtime: filenames lead with the trigger slug, so a
+    # lexicographic sort would order by incident TYPE, not recency
+    def _mtime(n):
+        try:
+            return os.path.getmtime(os.path.join(d, n))
+        except OSError:
+            return 0.0
+
+    names = sorted(os.listdir(d), key=_mtime)
+    flights = [n for n in names
+               if n.startswith("flight_") and n.endswith(".jsonl")]
+    traces = [n for n in names
+              if n.startswith("trace_") and n.endswith(".json")]
+    print(f"  flight-recorder dumps: {len(flights)}"
+          + (f" (newest: {flights[-1]})" if flights else ""))
+    print(f"  trace files: {len(traces)}"
+          + (f" (newest: {traces[-1]})" if traces else ""))
+
+
 def checkpoint_report(ckpt_dir: str) -> int:
     """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
     every save's manifest in a checkpoint dir, print the last-good tag.
@@ -152,6 +187,7 @@ def main(argv=None):
     print("=" * 60)
     env_info()
     fault_report()
+    trace_report()
     op_report()
     return 0
 

@@ -89,11 +89,15 @@ def chain_hash(prev: Optional[ChainKey], tokens: Sequence[int]) -> ChainKey:
 
 
 class BlockPool:
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int, tracer=None):
         if num_blocks < 1 or block_size < 1:
             raise ValueError("num_blocks and block_size must be >= 1")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        #: optional span/event sink (monitor.tracing.Tracer); None = free.
+        #: The pool only emits rare structural events (prefix evictions),
+        #: never per-token ones.
+        self.tracer = tracer
         # popping from the tail keeps allocation ascending-ish (cosmetic)
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         #: request ids holding each referenced page (len == refcount >= 1)
@@ -180,6 +184,10 @@ class BlockPool:
             del self._hash_to_block[h]
         self._free.append(bid)
         self.evictions += 1
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant("prefix_evict", cat="pool",
+                                args={"block": bid,
+                                      "cached": len(self._cached)})
 
     def free(self, block_ids: List[int], owner: str) -> None:
         """Release ``owner``'s references. A page whose last reference

@@ -54,6 +54,7 @@ Overload control and fault recovery (the resilience contract):
 """
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
@@ -63,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.layers import paged_cache_index
+from ...monitor.tracing import FlightRecorder, Tracer, dump_seq
 from ...utils import fault_injection
 from ...utils.logging import log_dist
 from ..engine import InferenceEngine, _sample_logits, next_pow2
@@ -144,6 +146,20 @@ class ServingConfig:
     #: quarantine requests whose logits go NaN/Inf instead of emitting
     #: garbage tokens
     logit_guard: bool = True
+    # -- tracing / flight recorder -------------------------------------
+    #: record span timelines (per-request phases, prefill chunks, decode
+    #: steps, compiles) into a bounded in-memory ring; export with
+    #: :meth:`ServingEngine.dump_trace`. Disabled tracing costs one
+    #: attribute check per emission site and allocates nothing.
+    trace: bool = False
+    #: directory for trace dumps + flight-recorder post-mortems; setting
+    #: it implies ``trace`` (watchdog trips and logit quarantines then
+    #: dump the last trace events + a metrics snapshot here)
+    trace_dir: Optional[str] = None
+    #: ring-buffer capacity in events (memory bound under any traffic)
+    trace_capacity: int = 8192
+    #: trace events included in each flight-recorder dump
+    flight_events: int = 512
 
 
 @dataclasses.dataclass
@@ -196,11 +212,26 @@ class ServingEngine:
         self._chunk = min(chunk, cfg.max_model_len) if chunk > 0 else 0
         self._chunk_budget = cfg.prefill_token_budget or self._chunk
 
+        # tracing first: scheduler and pool take the tracer at construction
+        # (NULL-like when disabled — emission sites cost one bool check)
+        self.tracer = Tracer(capacity=cfg.trace_capacity,
+                             enabled=bool(cfg.trace or cfg.trace_dir))
         self.nb_max = cfg.max_model_len // cfg.block_size
-        self.block_pool = BlockPool(cfg.num_blocks, cfg.block_size)
+        self.block_pool = BlockPool(cfg.num_blocks, cfg.block_size,
+                                    tracer=self.tracer)
         self.sched = Scheduler(cfg.max_batch_size, self.block_pool,
-                               self.nb_max, prefix_cache=cfg.prefix_cache)
+                               self.nb_max, prefix_cache=cfg.prefix_cache,
+                               tracer=self.tracer)
         self.metrics = ServingMetrics(blocks_total=cfg.num_blocks)
+        #: post-mortem capture: armed iff trace_dir is set — watchdog
+        #: trips, logit quarantines and DS_FAULT firings each dump the
+        #: last trace events + a metrics snapshot there
+        self.flight: Optional[FlightRecorder] = None
+        if cfg.trace_dir:
+            self.flight = FlightRecorder(cfg.trace_dir, self.tracer,
+                                         metrics_fn=self.metrics.snapshot,
+                                         last_n=cfg.flight_events)
+            self.flight.arm_faults()
 
         kv_dtype = jnp.int8 if engine.config.kv_cache_int8 \
             else engine.compute_dtype
@@ -292,8 +323,11 @@ class ServingEngine:
                 f"{min(self.nb_max, self.block_pool.num_blocks)} per "
                 f"sequence (raise num_blocks/max_model_len)")
         cfg = self.config
+        tr = self.tracer
         if self._draining:
             self.metrics.requests_rejected += 1
+            if tr.enabled:
+                tr.instant("reject", cat="sched", args={"reason": "draining"})
             raise RejectedError("draining", "engine is draining; "
                                 "no new admissions")
         # Both admission gates honor priority displacement: a newcomer that
@@ -337,6 +371,11 @@ class ServingEngine:
                 victims.append(v)
             if demand > budget:
                 self.metrics.requests_rejected += 1
+                if tr.enabled:
+                    tr.instant("reject", cat="sched",
+                               args={"reason": "kv_headroom",
+                                     "demand": int(demand),
+                                     "budget": int(budget)})
                 raise RejectedError(
                     "kv_headroom", f"committed KV demand {demand} "
                     f"blocks exceeds admission budget {budget} "
@@ -347,11 +386,20 @@ class ServingEngine:
             extra = next((v for v in displaceable if v not in victims), None)
             if extra is None:
                 self.metrics.requests_rejected += 1
+                if tr.enabled:
+                    tr.instant("reject", cat="sched",
+                               args={"reason": "queue_full",
+                                     "depth": self.sched.queue_depth})
                 raise RejectedError(
                     "queue_full", f"queue depth {self.sched.queue_depth} at "
                     f"cap {cfg.max_queue_depth}")
             victims.append(extra)
         for v in victims:
+            # the victim's terminal "request" span carries the
+            # shed_overload reason; this instant names who displaced it
+            if tr.enabled:
+                tr.instant("displace", cat="sched",
+                           args={"victim": v.rid, "priority": priority})
             self.sched.cancel(v, "shed_overload")
             self.metrics.requests_shed += 1
         if deadline_s is None:
@@ -370,6 +418,11 @@ class ServingEngine:
         self.sched.submit(req)
         self._requests[req.rid] = req
         self.metrics.requests_submitted += 1
+        if tr.enabled:
+            tr.instant("submit", cat="sched",
+                       args={"rid": req.rid, "prompt_tokens": len(prompt),
+                             "queue_depth": self.sched.queue_depth,
+                             "priority": priority})
         return req.rid
 
     def try_submit(self, prompt_ids, max_new_tokens: int = 16,
@@ -487,6 +540,26 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.sched.has_work()
 
+    # -- tracing / post-mortem -----------------------------------------
+
+    def _flight(self, trigger: str, **detail) -> None:
+        """Flight-recorder dump (no-op unless ``trace_dir`` armed one)."""
+        if self.flight is not None:
+            self.flight.record(trigger, detail)
+
+    def dump_trace(self, path: Optional[str] = None) -> str:
+        """Write the trace ring as Chrome-trace/Perfetto JSON. Default
+        path: ``<trace_dir>/trace_serving_<stamp>.json``."""
+        if path is None:
+            if not self.config.trace_dir:
+                raise ValueError("dump_trace() needs a path when "
+                                 "ServingConfig.trace_dir is unset")
+            path = os.path.join(
+                self.config.trace_dir,
+                f"trace_serving_{time.strftime('%Y%m%d-%H%M%S')}"
+                f"_{dump_seq():04d}_{os.getpid()}.json")
+        return self.tracer.dump(path)
+
     @property
     def prefill_chunk_tokens(self) -> int:
         """EFFECTIVE chunk length of the resident chunked-prefill program
@@ -529,6 +602,9 @@ class ServingEngine:
         if self._wedged is not None:
             if self._wedged.is_alive():
                 self.metrics.watchdog_skips += 1
+                if self.tracer.enabled:
+                    self.tracer.instant("watchdog_skip", cat="engine",
+                                        args={"step": self._step_no})
                 time.sleep(min(0.05, self.config.step_watchdog_s))
                 self._account_reaped()
                 # no record_step: a skipped step's sleep in the latency
@@ -652,6 +728,8 @@ class ServingEngine:
                                        tables, seq_lens, last_tok,
                                        jnp.asarray(corrupt), rng)
 
+            tr = self.tracer
+            t_dec = time.perf_counter() if tr.enabled else 0.0
             try:
                 # heartbeat.py's first-beat rule, in-process: the first
                 # decode invocation contains the XLA compile (often far
@@ -665,19 +743,38 @@ class ServingEngine:
             except StepWatchdogTimeout as e:
                 log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
                 self.metrics.watchdog_trips += 1
+                rids = [r.rid for _, r in active]
+                if tr.enabled:
+                    tr.instant("watchdog_trip", cat="engine",
+                               args={"step": step_no, "rids": rids})
                 for slot, req in active:
                     self.sched.fail(req, "step_watchdog")
                     self._clear_slot_arrays(slot)
                     self.metrics.requests_failed += 1
+                # post-mortem: the last trace events + metrics, naming the
+                # requests the trip failed
+                self._flight("watchdog_trip", step=step_no, rids=rids,
+                             budget_s=self.config.step_watchdog_s)
             else:
+                if tr.enabled:
+                    tr.complete("decode_step", t_dec, time.perf_counter(),
+                                cat="engine",
+                                args={"step": step_no,
+                                      "active": len(active)})
                 toks = np.asarray(toks)
                 bad = np.asarray(bad)
                 for slot, req in active:
                     if self.config.logit_guard and bad[slot]:
+                        if tr.enabled:
+                            tr.instant("quarantine", cat="engine",
+                                       args={"rid": req.rid, "slot": slot,
+                                             "step": step_no})
                         self.sched.fail(req, "corrupt_logits")
                         self._clear_slot_arrays(slot)
                         self.metrics.logit_quarantines += 1
                         self.metrics.requests_failed += 1
+                        self._flight("logit_quarantine", rid=req.rid,
+                                     slot=slot, step=step_no)
                         continue
                     req.seq_len += 1
                     self._seq_lens[slot] = req.seq_len
@@ -692,6 +789,9 @@ class ServingEngine:
 
     def _finish_step_bookkeeping(self, t0: float, brownout: bool,
                                  record_latency: bool = True) -> None:
+        if self.tracer.enabled:
+            self.tracer.complete("step", t0, time.perf_counter(),
+                                 cat="engine", args={"step": self._step_no})
         self._step_no += 1
         m = self.metrics
         m.steps += 1
@@ -836,10 +936,15 @@ class ServingEngine:
         if fn is None:
             fn = self._prefill_fns[Tb] = self._build_prefill(Tb)
         self._rng, rng = jax.random.split(self._rng)
+        tr = self.tracer
+        t_pf = time.perf_counter() if tr.enabled else 0.0
         tok, bad, self.pool = fn(self.engine.params, self.pool,
                                  jnp.asarray(self._tables[req.slot][None]),
                                  jnp.asarray(ids), jnp.asarray([L], np.int32),
                                  rng)
+        if tr.enabled:
+            tr.complete("prefill", t_pf, time.perf_counter(), cat="engine",
+                        args={"rid": req.rid, "tokens": L, "bucket": Tb})
         req.seq_len = L
         req.prefill_done = L
         self._seq_lens[req.slot] = L
@@ -848,10 +953,15 @@ class ServingEngine:
         self.metrics.window_tokens += L
         if self.config.logit_guard and bool(np.asarray(bad)[0]):
             slot = req.slot
+            if tr.enabled:
+                tr.instant("quarantine", cat="engine",
+                           args={"rid": req.rid, "where": "prefill"})
             self.sched.fail(req, "corrupt_logits")
             self._clear_slot_arrays(slot)
             self.metrics.logit_quarantines += 1
             self.metrics.requests_failed += 1
+            self._flight("logit_quarantine", rid=req.rid, where="prefill",
+                         step=self._step_no)
             return
         self._harvest(req, int(np.asarray(tok)[0]))
 
@@ -889,10 +999,18 @@ class ServingEngine:
                     log_dist(f"serving: chunked prefill watchdog tripped "
                              f"for {req.rid}: {e}", ranks=[0])
                     self.metrics.watchdog_trips += 1
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "watchdog_trip", cat="engine",
+                            args={"step": self._step_no, "rids": [req.rid],
+                                  "where": "chunked_prefill"})
                     slot = req.slot
                     self.sched.fail(req, "step_watchdog")
                     self._clear_slot_arrays(slot)
                     self.metrics.requests_failed += 1
+                    self._flight("watchdog_trip", step=self._step_no,
+                                 rids=[req.rid], where="chunked_prefill",
+                                 budget_s=self.config.step_watchdog_s)
                     return
                 except Exception as e:
                     self._fail_prefill(req, e)
@@ -954,11 +1072,17 @@ class ServingEngine:
         # step watchdog bounds it exactly like decode (a wedged chunk must
         # fail ITS request and keep the engine serving, not hang every
         # tenant); the first call carries the XLA compile and is exempt
+        tr = self.tracer
+        t_ck = time.perf_counter() if tr.enabled else 0.0
         if self._chunked_warm:
             tok, bad, self.pool = self._guarded(device_call)
         else:
             tok, bad, self.pool = device_call()
             self._chunked_warm = True
+        if tr.enabled:
+            tr.complete("prefill_chunk", t_ck, time.perf_counter(),
+                        cat="engine",
+                        args={"rid": req.rid, "start": start, "tokens": n})
         req.prefill_done = start + n
         req.seq_len = start + n
         self.metrics.prefill_tokens += n
@@ -971,10 +1095,15 @@ class ServingEngine:
         # identical prompt would reuse the poisoned KV
         if self.config.logit_guard and bool(np.asarray(bad)[0]):
             slot = req.slot
+            if tr.enabled:
+                tr.instant("quarantine", cat="engine",
+                           args={"rid": req.rid, "where": "prefill_chunk"})
             self.sched.fail(req, "corrupt_logits")
             self._clear_slot_arrays(slot)
             self.metrics.logit_quarantines += 1
             self.metrics.requests_failed += 1
+            self._flight("logit_quarantine", rid=req.rid,
+                         where="prefill_chunk", step=self._step_no)
             return
         self._commit_full_blocks(req)
         if req.prefill_done < req.prefill_target:
@@ -1007,6 +1136,10 @@ class ServingEngine:
                                          jnp.asarray([new], jnp.int32))
         req.blocks[block_idx] = new
         self.metrics.cow_copies += 1
+        if self.tracer.enabled:
+            self.tracer.instant("cow", cat="pool",
+                                args={"rid": req.rid, "src": bid,
+                                      "dst": new})
 
     def _commit_full_blocks(self, req: Request) -> None:
         """Content-index every COMPLETELY written page of this sequence
@@ -1037,9 +1170,17 @@ class ServingEngine:
         self._last_tok[req.slot] = token
         self.metrics.tokens_generated += 1
         self.metrics.window_tokens += 1
-        if req.first_token_time is None:
+        first = req.first_token_time is None
+        if first:
             req.first_token_time = time.perf_counter()
             self.metrics.record_ttft(req.ttft)
+        # prefill phase -> decode phase on the first token of THIS
+        # admission (cheap no-op when already decoding)
+        self.sched.note_decoding(req)
+        if first and self.tracer.enabled:
+            self.tracer.instant("first_token", cat="request",
+                                args={"rid": req.rid,
+                                      "ttft_s": round(req.ttft, 6)})
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._finish(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
@@ -1073,6 +1214,8 @@ class ServingEngine:
         def decode(params, pool, tables, seq_lens, last_tok, corrupt, rng):
             # trace-time side effect: runs once per XLA compile
             self.compile_counts["decode"] += 1
+            self.tracer.instant("xla_compile", cat="engine",
+                                args={"kind": "decode"})
             params = self._dequant(params)
             idx = paged_cache_index(tables, seq_lens[:, None], seq_lens + 1)
             logits, pool = module.apply({"params": params},
@@ -1103,6 +1246,8 @@ class ServingEngine:
 
         def prefill(params, pool, table_row, ids, length, rng):
             self.compile_counts["prefill"] += 1
+            self.tracer.instant("xla_compile", cat="engine",
+                                args={"kind": "prefill", "bucket": t_bucket})
             params = self._dequant(params)
             ar = jnp.arange(t_bucket)[None, :]
             append_pos = jnp.where(ar < length[:, None], ar, -1)
@@ -1138,6 +1283,8 @@ class ServingEngine:
         def chunked_prefill(params, pool, table_row, ids, start, length,
                             corrupt, rng):
             self.compile_counts["chunked_prefill"] += 1
+            self.tracer.instant("xla_compile", cat="engine",
+                                args={"kind": "chunked_prefill"})
             params = self._dequant(params)
             ar = jnp.arange(t_chunk)[None, :]
             append_pos = jnp.where(ar < length[:, None],

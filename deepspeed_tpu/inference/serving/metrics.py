@@ -3,30 +3,31 @@
 The engine updates one ``ServingMetrics`` per step; ``to_events`` renders
 the snapshot as the ``(tag, value, step)`` tuples every monitor backend
 (TensorBoard / W&B / CSV) already consumes — no backend changes needed.
+
+Latency distributions ride the unified registry's **log-bucket
+histograms** (``monitor/registry.py``): the old 4096-sample windows
+biased p95 toward recent traffic and forgot bursts outright; the
+histograms are O(1) memory under sustained traffic and their quantiles
+cover the whole run. ``snapshot()`` keys are unchanged
+(``ttft_p50_s``/``ttft_p95_s``/``step_p50_s``/``step_p95_s``) so monitor
+wiring and ``ds_bench`` artifacts keep parsing; p99 keys are new.
 """
 
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...monitor.registry import Histogram, MetricsRegistry
+
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over raw samples (kept for the bench
+    harnesses that collect their own per-request lists)."""
     if not values:
         return None
     xs = sorted(values)
     idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
     return xs[idx]
-
-
-#: samples kept per latency distribution — bounds both memory and the
-#: per-step sort a monitored engine pays in snapshot() on long-lived servers
-_WINDOW = 4096
-
-
-def _push(values: List[float], x: float) -> None:
-    values.append(x)
-    if len(values) > _WINDOW:
-        del values[:len(values) - _WINDOW]
 
 
 @dataclass
@@ -79,20 +80,29 @@ class ServingMetrics:
     #: token budget is starving long prompts
     chunked_prefill_queue_age_s: float = 0.0
     brownout_active: bool = False
-    # distributions (windowed to _WINDOW samples — see record_ttft/record_step)
-    ttft_s: List[float] = field(default_factory=list)
-    step_s: List[float] = field(default_factory=list)
+    #: the unified registry backing the latency histograms; shared with
+    #: anything else that wants to register serving-scoped metrics
+    registry: MetricsRegistry = field(default_factory=MetricsRegistry)
     # throughput window: re-anchored whenever traffic resumes after a
     # drain, so tokens/sec reflects the CURRENT serving rate instead of
     # decaying across idle gaps
     window_start: float = field(default_factory=time.perf_counter)
     window_tokens: int = 0
 
+    def __post_init__(self):
+        # fixed log buckets spanning 10us..1h of latency; O(1) memory
+        # under unbounded traffic, quantile error bounded by the 1.1
+        # growth factor (~5%)
+        self.ttft_hist: Histogram = self.registry.histogram(
+            "ttft_s", lo=1e-5, hi=4e3)
+        self.step_hist: Histogram = self.registry.histogram(
+            "step_s", lo=1e-5, hi=4e3)
+
     def record_ttft(self, x: float) -> None:
-        _push(self.ttft_s, x)
+        self.ttft_hist.observe(x)
 
     def record_step(self, x: float) -> None:
-        _push(self.step_s, x)
+        self.step_hist.observe(x)
 
     def on_traffic_resume(self) -> None:
         self.window_start = time.perf_counter()
@@ -157,12 +167,14 @@ class ServingMetrics:
             "preemptions": float(self.preemptions),
             "steps": float(self.steps),
         }
-        if self.ttft_s:
-            out["ttft_p50_s"] = _percentile(self.ttft_s, 0.5)
-            out["ttft_p95_s"] = _percentile(self.ttft_s, 0.95)
-        if self.step_s:
-            out["step_p50_s"] = _percentile(self.step_s, 0.5)
-            out["step_p95_s"] = _percentile(self.step_s, 0.95)
+        if self.ttft_hist.count:
+            out["ttft_p50_s"] = self.ttft_hist.percentile(0.5)
+            out["ttft_p95_s"] = self.ttft_hist.percentile(0.95)
+            out["ttft_p99_s"] = self.ttft_hist.percentile(0.99)
+        if self.step_hist.count:
+            out["step_p50_s"] = self.step_hist.percentile(0.5)
+            out["step_p95_s"] = self.step_hist.percentile(0.95)
+            out["step_p99_s"] = self.step_hist.percentile(0.99)
         return out
 
     def to_events(self, step: int):

@@ -31,6 +31,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Tuple
 
+from ...monitor.tracing import NULL_TRACER, Tracer
 from .block_pool import BlockPool, ChainKey
 
 
@@ -101,6 +102,18 @@ class Request:
     finish_reason: Optional[str] = None
     preemptions: int = 0
     admit_order: int = -1     # monotone stamp set at admission (victim pick)
+    #: latest admission stamp (perf_counter seconds; None while queued)
+    admit_time: Optional[float] = None
+    # -- tracing: the request's current lifecycle phase -----------------
+    # phases partition submit -> terminal into contiguous, non-overlapping
+    # spans (queue | prefill | decode); every transition emits the span it
+    # closes, so a trace reconstructs exactly where a request's latency
+    # went. Preemption re-opens "queue"; TTFT = queue + prefill.
+    phase: str = "queue"
+    phase_start: float = 0.0
+
+    def __post_init__(self):
+        self.phase_start = self.submit_time
 
     @property
     def done(self) -> bool:
@@ -145,7 +158,8 @@ class Request:
 
 class Scheduler:
     def __init__(self, num_slots: int, pool: BlockPool,
-                 max_blocks_per_seq: int, prefix_cache: bool = False):
+                 max_blocks_per_seq: int, prefix_cache: bool = False,
+                 tracer: Optional[Tracer] = None):
         self.num_slots = num_slots
         self.pool = pool
         self.max_blocks_per_seq = max_blocks_per_seq
@@ -153,6 +167,10 @@ class Scheduler:
         #: longest cached prefix and acquires those pages instead of
         #: recomputing them
         self.prefix_cache = prefix_cache
+        #: span sink for the per-request timeline (NULL_TRACER = free).
+        #: Identity check, not truthiness — an EMPTY tracer is len() 0
+        #: and would falsely read as "no tracer"
+        self.tracer = NULL_TRACER if tracer is None else tracer
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * num_slots
         self.admit_log: List[str] = []   # rids in true admission order
@@ -160,6 +178,30 @@ class Scheduler:
         #: requests ``admit_next``/``expire_queued`` moved to TIMEOUT this
         #: step; the engine drains it for metrics/accounting
         self.reaped: List[Request] = []
+
+    # -- tracing: phase transitions ------------------------------------
+
+    def _phase(self, req: Request, new_phase: str,
+               now: Optional[float] = None) -> None:
+        """Close the request's current phase (emitting its span) and open
+        ``new_phase``. Phase spans are contiguous by construction: each
+        starts exactly where the previous ended, so a request's phases
+        tile submit -> terminal with no gaps and no overlap."""
+        now = time.perf_counter() if now is None else now
+        if self.tracer.enabled:
+            self.tracer.complete(f"phase:{req.phase}", req.phase_start, now,
+                                 cat="request", args={"rid": req.rid})
+        req.phase = new_phase
+        req.phase_start = now
+
+    def note_decoding(self, req: Request) -> None:
+        """The engine sampled a token for this request: if it was still in
+        its prefill phase (first token after THIS admission — the original
+        one or a post-preemption resume), prefill ends here and decode
+        begins. For the first-ever token that boundary IS the TTFT split:
+        TTFT = queue + prefill by construction."""
+        if req.phase == "prefill":
+            self._phase(req, "decode")
 
     # -- introspection -------------------------------------------------
 
@@ -294,6 +336,15 @@ class Scheduler:
         req.slot = slot
         req.state = RequestState.RUNNING
         req.admit_order = next(self._admit_stamp)
+        req.admit_time = time.perf_counter()
+        # queue phase ends, prefill begins — the queue_wait share of TTFT
+        # is this span; prefix-cache hits show up as its args
+        self._phase(req, "prefill", now=req.admit_time)
+        if self.tracer.enabled:
+            self.tracer.instant("admit", cat="sched",
+                                args={"rid": req.rid,
+                                      "prefix_tokens": req.prefix_len,
+                                      "queue_depth": len(self.queue)})
         self.slots[slot] = req
         self.admit_log.append(req.rid)
         if len(self.admit_log) > 65536:  # bounded on long-lived servers
@@ -351,6 +402,13 @@ class Scheduler:
                 req.resume_tokens)
         req.state = RequestState.QUEUED
         req.preemptions += 1
+        # back to the queue: whatever phase was open (prefill or decode)
+        # closes here and a new queue span begins
+        self._phase(req, "queue")
+        if self.tracer.enabled:
+            self.tracer.instant("preempt", cat="sched",
+                                args={"rid": req.rid,
+                                      "preemptions": req.preemptions})
         self.queue.appendleft(req)
 
     # -- completion (every terminal transition funnels through _release,
@@ -370,6 +428,19 @@ class Scheduler:
         req.state = state
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
+        # terminal: close the open phase and emit the request's umbrella
+        # span (submit -> terminal) — the timeline-completeness contract:
+        # EVERY terminal request has a request span whose phases tile it
+        self._phase(req, "terminal", now=req.finish_time)
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "request", req.submit_time, req.finish_time, cat="request",
+                args={"rid": req.rid, "state": state.value, "reason": reason,
+                      "prompt_tokens": len(req.prompt),
+                      "generated": len(req.tokens),
+                      "preemptions": req.preemptions,
+                      "ttft_s": None if req.ttft is None
+                      else round(req.ttft, 6)})
 
     def finish(self, req: Request, reason: str) -> None:
         self._release(req, RequestState.FINISHED, reason)

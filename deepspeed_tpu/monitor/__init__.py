@@ -1,0 +1,5 @@
+from .monitor import MonitorMaster, events_from_scalars  # noqa: F401
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry)
+from .tracing import (FlightRecorder, NULL_TRACER, Tracer,  # noqa: F401
+                      configure, flight_dump, get_tracer, validate_event)

@@ -127,3 +127,12 @@ class MonitorMaster(Monitor):
         self.tb_monitor.write_events(event_list)
         self.wandb_monitor.write_events(event_list)
         self.csv_monitor.write_events(event_list)
+
+    def write_registry(self, registry, step: int, prefix: str = "") -> None:
+        """Fan a :class:`~deepspeed_tpu.monitor.registry.MetricsRegistry`
+        snapshot out to every enabled backend — the one bridge between the
+        unified registry (counters/gauges/log-bucket histograms) and the
+        TensorBoard/W&B/CSV writers."""
+        if not self.enabled:
+            return
+        self.write_events(registry.to_events(step, prefix=prefix))

@@ -181,6 +181,24 @@ class FaultToleranceConfig(DeepSpeedConfigModel):
     keep_checkpoints: int = 2
 
 
+class TracingConfig(DeepSpeedConfigModel):
+    """Structured tracing + flight recorder (``monitor/tracing.py``):
+    span timelines for the training step loop (train_batch / train_step
+    dispatch / checkpoint I/O) over a bounded ring buffer, with
+    post-mortem dumps on DS_FAULT firings and checkpoint-verify failures.
+    ``DS_TRACE_DIR`` in the environment arms this block without config
+    changes (the operator's break-glass switch)."""
+
+    enabled: bool = False
+    #: ring-buffer capacity in events
+    capacity: int = 8192
+    #: directory for trace dumps + flight-recorder post-mortems; setting
+    #: it implies ``enabled``
+    dir: Optional[str] = None
+    #: trace events per flight-recorder dump
+    flight_events: int = 512
+
+
 class AutotuningConfig(DeepSpeedConfigModel):
     enabled: bool = False
     fast: bool = True
@@ -327,6 +345,7 @@ class DeepSpeedConfig:
         self.aio = AIOConfig(**get("aio", {}))
         self.elasticity = ElasticityConfig(**get("elasticity", {}))
         self.fault_tolerance = FaultToleranceConfig(**get("fault_tolerance", {}))
+        self.tracing = TracingConfig(**get("tracing", {}))
         self.autotuning = AutotuningConfig(**get("autotuning", {}))
         self.quantize_training = QuantizeTrainingConfig(**get("quantize_training", {}))
         self.parallel = ParallelConfig(**get("parallel", {}))

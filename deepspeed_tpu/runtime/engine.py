@@ -403,6 +403,31 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.monitor = self._build_monitor()
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
 
+        # ---- tracing / flight recorder / metrics registry --------------
+        # span timelines for the step loop + post-mortem dumps on DS_FAULT
+        # firings and checkpoint-verify failures; armed by the config
+        # block or the DS_TRACE_DIR env var (monitor/tracing.py). The
+        # registry's log-bucket step-latency histogram flows to every
+        # monitor backend through MonitorMaster.write_registry.
+        from ..monitor.registry import MetricsRegistry
+        from ..monitor.tracing import (ENV_TRACE_DIR, FlightRecorder,
+                                       Tracer)
+
+        self.registry = MetricsRegistry()
+        self._step_hist = self.registry.histogram("train_batch_s",
+                                                  lo=1e-4, hi=4e3)
+        tcfg = self._config.tracing
+        trace_dir = tcfg.dir or os.environ.get(ENV_TRACE_DIR)
+        self.tracer = Tracer(capacity=tcfg.capacity,
+                             enabled=bool(tcfg.enabled or trace_dir))
+        self.flight = None
+        if trace_dir:
+            self.flight = FlightRecorder(
+                trace_dir, self.tracer, last_n=tcfg.flight_events,
+                metrics_fn=lambda: {"global_steps": self.global_steps,
+                                    **self.registry.snapshot()})
+            self.flight.arm_faults()
+
         # micro-step parity API state
         self._pending_microbatches = []
         self._last_loss = None
@@ -762,11 +787,16 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         global batch (leading dim = train_batch_size) or an iterator yielding
         microbatches.
         """
+        t_batch0 = time.perf_counter()
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or a data iterator")
             micro = [next(data_iter) for _ in range(self.gradient_accumulation_steps)]
             batch = {k: np.concatenate([np.asarray(m[k]) for m in micro]) for k in micro[0]}
+            if self.tracer.enabled:
+                self.tracer.complete("data_fetch", t_batch0,
+                                     time.perf_counter(), cat="train",
+                                     args={"step": self.global_steps})
 
         if self.curriculum_scheduler is not None:
             # truncate token dims to this step's difficulty (reference injects
@@ -816,16 +846,30 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             self.timers("train_batch").start()
         self.tput_timer.start()
 
+        tr = self.tracer
         if self._offload:
+            t_step0 = time.perf_counter() if tr.enabled else 0.0
             loss = self._offload_train_batch(batch)
+            if tr.enabled:
+                tr.complete("train_step", t_step0, time.perf_counter(),
+                            cat="train", args={"step": self.global_steps,
+                                               "offload": True})
         else:
             batch = self._shape_batch(batch)
             self._rng, step_rng = jax.random.split(self._rng)
             fp = self._config.flops_profiler
             profiling = (fp.enabled and self.global_steps == fp.profile_step)
             t0 = time.perf_counter() if profiling else None
+            # span covers the fused fwd/bwd/optimizer DISPATCH (XLA runs
+            # the three as one program; wall_clock_breakdown timers remain
+            # the per-phase estimate) — forcing the loss here would fence
+            # the device every step just to trace
+            t_step0 = time.perf_counter() if tr.enabled else 0.0
             self.state, (loss, self._last_grad_norm), overflow = \
                 self._train_step(self.state, batch, step_rng)
+            if tr.enabled:
+                tr.complete("train_step", t_step0, time.perf_counter(),
+                            cat="train", args={"step": self.global_steps})
             if profiling:
                 float(loss)  # device fence so the measured latency is real
                 self._print_flops_profile(batch, step_rng,
@@ -854,6 +898,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.tput_timer.stop()
         if self.wall_clock_breakdown:
             self.timers("train_batch").stop()
+        self._step_hist.observe(time.perf_counter() - t_batch0)
+        if tr.enabled:
+            tr.complete("train_batch", t_batch0, time.perf_counter(),
+                        cat="train", args={"step": self.global_steps - 1})
 
         if self.monitor is not None and self.monitor.enabled:
             self._write_monitor(loss)
@@ -1017,6 +1065,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             events.append(("Train/Samples/grad_norm", gn,
                            self.global_steps * self.train_batch_size))
         self.monitor.write_events(events)
+        # the unified registry (step/checkpoint latency histograms) rides
+        # the same backends — one bridge, no backend changes
+        self.monitor.write_registry(self.registry, self.global_steps,
+                                    prefix="Train/Registry/")
 
     def _report_progress(self, loss):
         log_dist(f"step={self.global_steps}, skipped={self.get_skipped_steps()}, "
@@ -1037,6 +1089,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         client_state.update(global_steps=self.global_steps,
                             skipped_steps=self.get_skipped_steps())
         ft = self._config.fault_tolerance
+        t_save0 = time.perf_counter()
         if self._offload:
             # host-side fp32 masters + moments live outside TrainState;
             # written BEFORE the manifest so the save's integrity check
@@ -1054,6 +1107,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                          save_retries=ft.save_retries if ft.enabled else 0,
                          retry_backoff_s=ft.save_retry_backoff,
                          manifest_checksums=ft.manifest_checksums)
+        # checkpoint I/O is the step loop's big non-compute latency — a
+        # traced run shows exactly which steps paid it
+        if self.tracer.enabled:
+            self.tracer.complete("checkpoint_save", t_save0,
+                                 time.perf_counter(), cat="checkpoint",
+                                 args={"tag": tag})
+        self.registry.histogram("checkpoint_save_s", lo=1e-3,
+                                hi=4e3).observe(time.perf_counter() - t_save0)
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -1106,7 +1167,21 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             # resolve+verify once up front (fallback walk on corrupt/partial
             # saves) so the offload sidecar below agrees with the restored
             # tag; load_train_state then takes the concrete tag as-is
-            tag = resolve_load_tag(load_dir, tag)
+            try:
+                tag = resolve_load_tag(load_dir, tag)
+            except Exception as e:
+                # a verify failure with NO loadable fallback is an
+                # incident: leave a post-mortem before propagating.
+                # manifest.py already dumps through the process-global
+                # recorder (it has no engine handle), so only dump here
+                # when no global recorder is armed — one incident, one dump
+                from ..monitor.tracing import default_flight_recorder
+                if (self.flight is not None
+                        and default_flight_recorder() is None):
+                    self.flight.record("checkpoint_verify",
+                                       {"dir": load_dir, "tag": tag,
+                                        "error": str(e)})
+                raise
         state, client_state = load_train_state(
             load_dir, tag, self.state, self.state_shardings,
             load_optimizer_states=load_optimizer_states, verify=False)

@@ -149,10 +149,45 @@ def get_fault(name: str, *, step: Optional[int] = None,
 
 def reset() -> None:
     """Forget trigger counts and reseed the probabilistic stream (test
-    isolation)."""
+    isolation). Listeners survive a reset on purpose: a flight recorder
+    armed for the whole chaos drill must keep observing across the
+    per-test DS_FAULT re-arms."""
     global _cache, _prob
     _cache = (None, [])
     _prob = None
+
+
+# ---------------------------------------------------------------------------
+# Fault-firing listeners (observability hook)
+# ---------------------------------------------------------------------------
+
+#: callbacks invoked as ``cb(name, ctx)`` every time a fault FIRES (after
+#: the spec matched and consumed its trigger count, before the damage).
+#: The flight recorder subscribes here so every injected incident leaves a
+#: post-mortem dump — including ``maybe_crash``, which notifies before
+#: ``os._exit``.
+_listeners: List[Callable[[str, Dict[str, Any]], None]] = []
+
+
+def add_listener(cb: Callable[[str, Dict[str, Any]], None]) -> None:
+    if cb not in _listeners:
+        _listeners.append(cb)
+
+
+def remove_listener(cb: Callable[[str, Dict[str, Any]], None]) -> None:
+    try:
+        _listeners.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify(name: str, ctx: Dict[str, Any]) -> None:
+    for cb in list(_listeners):
+        try:
+            cb(name, ctx)
+        except Exception as e:  # an observer must never alter the drill
+            logger.warning(f"DS_FAULT listener {cb!r} failed: "
+                           f"{type(e).__name__}: {e}")
 
 
 # ---------------------------------------------------------------------------
@@ -166,6 +201,9 @@ def maybe_crash(name: str, **ctx: Any) -> None:
     if spec is None:
         return
     spec.fired += 1
+    # notify BEFORE dying: this is exactly the post-mortem the flight
+    # recorder exists for
+    _notify(name, ctx)
     logger.error(f"DS_FAULT: injected crash at {name} ({ctx})")
     import sys
 
@@ -179,6 +217,7 @@ def maybe_stall(name: str, **ctx: Any) -> None:
     if spec is None:
         return
     spec.fired += 1
+    _notify(name, ctx)
     seconds = float(spec.params.get("seconds", 10 * 365 * 24 * 3600))
     logger.error(f"DS_FAULT: injected stall at {name} ({ctx}); "
                  f"sleeping {seconds:.0f}s")
@@ -196,6 +235,7 @@ def maybe_flag(name: str, **ctx: Any) -> Optional[FaultSpec]:
     if spec is None:
         return None
     spec.fired += 1
+    _notify(name, ctx)
     logger.error(f"DS_FAULT: armed {name} at {ctx}")
     return spec
 
@@ -206,6 +246,7 @@ def maybe_fail(name: str, exc: Type[Exception] = OSError, **ctx: Any) -> None:
     if spec is None:
         return
     spec.fired += 1
+    _notify(name, ctx)
     raise exc(f"DS_FAULT: injected failure at {name} "
               f"(attempt {spec.fired}, {ctx})")
 
@@ -216,6 +257,7 @@ def maybe_corrupt_file(name: str, path: str, **ctx: Any) -> None:
     if spec is None or not os.path.exists(path):
         return
     spec.fired += 1
+    _notify(name, {**ctx, "path": path})
     logger.error(f"DS_FAULT: corrupting {path} ({name})")
     with open(path, "r+b") as f:
         f.write(b"\x00CORRUPT\x00")
@@ -227,6 +269,7 @@ def maybe_truncate_file(name: str, path: str, **ctx: Any) -> None:
     if spec is None or not os.path.exists(path):
         return
     spec.fired += 1
+    _notify(name, {**ctx, "path": path})
     size = os.path.getsize(path)
     logger.error(f"DS_FAULT: truncating {path} to {size // 2} bytes ({name})")
     with open(path, "r+b") as f:

@@ -37,6 +37,40 @@ def test_malformed_jsonl_error_records_nonzero_exit(tmp_path):
     assert "tokenizer" in recs[4]["error"]
 
 
+def test_demo_trace_dir_writes_perfetto_trace_and_stats(tmp_path):
+    """The observability acceptance path: a --demo --trace-dir run must
+    leave a Perfetto-loadable trace with complete per-request timelines,
+    and --stats-interval-s must put health lines on stderr (stdout stays
+    pure result JSONL)."""
+    trace_dir = tmp_path / "traces"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "4", "--cpu", "--trace-dir", str(trace_dir),
+         "--stats-interval-s", "1"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert "[ds_serve] steps=" in r.stderr         # the health line
+    assert "trace written:" in r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.strip().startswith("{")]  # skip engine-init log lines
+    final = recs[-1]
+    trace_file = final["trace_file"]
+    assert os.path.exists(trace_file)
+    assert final["flight_dumps"] == []             # clean run: no incidents
+
+    from deepspeed_tpu.monitor.tracing import validate_event
+
+    doc = json.load(open(trace_file))
+    evs = doc["traceEvents"]
+    assert all(validate_event(e) is None for e in evs)
+    # complete timelines: every demo request has a terminal umbrella span
+    rids = {rec["rid"] for rec in recs if "rid" in rec}
+    assert len(rids) == 4
+    umbrellas = {(e.get("args") or {}).get("rid") for e in evs
+                 if e["name"] == "request"}
+    assert rids <= umbrellas
+
+
 def test_demo_cannot_mix_with_prompts(tmp_path):
     p = tmp_path / "p.jsonl"
     p.write_text('{"prompt_ids": [1]}\n')

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Trace inspector (``tools/trace_view.py``): schema validation + the
+per-request TTFT phase breakdown.
+
+Reads either artifact the tracing stack writes:
+
+- a Chrome-trace JSON (``ServingEngine.dump_trace`` / ``Tracer.dump`` /
+  ``ds_serve --trace-dir``) — object with a ``traceEvents`` list;
+- a flight-recorder JSONL post-mortem (header line with
+  ``kind=flight_recorder``, then one trace event per line).
+
+Every event is checked against the schema in
+``deepspeed_tpu.monitor.tracing.validate_event`` — THE schema, not a
+copy, so the checker cannot drift from the producer. A malformed event
+fails the run with a named offender (index, name, and what is wrong)
+and exit code 1; a file that validates prints the per-request phase
+breakdown: how each request's TTFT splits into queue wait vs prefill
+(the serving scheduler guarantees phases tile submit -> terminal, so
+queue + prefill = TTFT by construction), plus decode time and totals.
+
+  python tools/trace_view.py /tmp/traces/trace_serving_*.json
+  python tools/trace_view.py /tmp/traces/flight_watchdog_trip_*.jsonl
+  python tools/trace_view.py trace.json --json   # machine-readable
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.monitor.tracing import validate_event  # noqa: E402
+
+#: request phase names the scheduler emits (tracing.py's span contract)
+PHASES = ("queue", "prefill", "decode")
+
+
+def load_events(path: str) -> Tuple[List[Dict[str, Any]],
+                                    Optional[Dict[str, Any]]]:
+    """Events + optional flight-recorder header from either file format.
+    Raises ValueError naming what is structurally wrong with the file."""
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        raise ValueError("file is empty")
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents")
+        if not isinstance(evs, list):
+            raise ValueError("JSON object has no 'traceEvents' list — not "
+                             "a Chrome-trace file")
+        return evs, None
+    # not one JSON doc: try flight-recorder JSONL (one record per line)
+    events: List[Dict[str, Any]] = []
+    header: Optional[Dict[str, Any]] = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"line {lineno} is not valid JSON ({e})")
+        if lineno == 1 and isinstance(rec, dict) and \
+                rec.get("kind") == "flight_recorder":
+            header = rec
+            continue
+        events.append(rec)
+    if header is None:
+        raise ValueError("not a Chrome-trace JSON and line 1 is not a "
+                         "flight_recorder header")
+    return events, header
+
+
+def validate(events: List[Dict[str, Any]]) -> Optional[str]:
+    """First schema violation as a named offender, None when clean."""
+    for i, ev in enumerate(events):
+        problem = validate_event(ev)
+        if problem is not None:
+            name = ev.get("name") if isinstance(ev, dict) else None
+            return f"event #{i} (name={name!r}): {problem}"
+    return None
+
+
+def request_breakdown(events: List[Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Per-rid phase totals from the request-category spans.
+
+    Returns {rid: {queue_s, prefill_s, decode_s, total_s, ttft_s, state,
+    reason, preemptions, complete}}; ``complete`` is False when the ring
+    wrapped past the request's spans (partial evidence, still shown)."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def rec(rid: str) -> Dict[str, Any]:
+        if rid not in out:
+            out[rid] = {f"{p}_s": 0.0 for p in PHASES}
+            out[rid].update(total_s=None, ttft_s=None, state=None,
+                            reason=None, preemptions=0, complete=False)
+        return out[rid]
+
+    for ev in events:
+        args = ev.get("args") or {}
+        rid = args.get("rid")
+        if rid is None:
+            continue
+        name = ev.get("name", "")
+        if name.startswith("phase:"):
+            phase = name.split(":", 1)[1]
+            if phase in PHASES:
+                rec(rid)[f"{phase}_s"] += ev.get("dur", 0.0) / 1e6
+        elif name == "request":
+            r = rec(rid)
+            r["total_s"] = ev.get("dur", 0.0) / 1e6
+            r["ttft_s"] = args.get("ttft_s")
+            r["state"] = args.get("state")
+            r["reason"] = args.get("reason")
+            r["preemptions"] = args.get("preemptions", 0)
+            r["complete"] = True
+    return out
+
+
+def _share(part: float, whole: Optional[float]) -> str:
+    if not whole:
+        return "  n/a"
+    return f"{100.0 * part / whole:4.0f}%"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="validate a trace / "
+                                 "flight-recorder file and print the "
+                                 "per-request TTFT phase breakdown")
+    ap.add_argument("path", help="Chrome-trace JSON or flight-recorder "
+                                 "JSONL")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the breakdown as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    try:
+        events, header = load_events(args.path)
+    except (OSError, ValueError) as e:
+        print(f"trace_view: {args.path}: {e}", file=sys.stderr)
+        return 1
+    problem = validate(events)
+    if problem is not None:
+        print(f"trace_view: {args.path}: schema violation at {problem}",
+              file=sys.stderr)
+        return 1
+
+    reqs = request_breakdown(events)
+    if args.json:
+        print(json.dumps({"path": args.path, "events": len(events),
+                          "flight_header": header, "requests": reqs},
+                         indent=2))
+        return 0
+
+    print(f"{args.path}: {len(events)} events, schema OK")
+    if header is not None:
+        print(f"flight recorder: trigger={header.get('trigger')!r} "
+              f"detail={json.dumps(header.get('detail', {}))} "
+              f"(dropped={header.get('events_dropped', 0)})")
+    if not reqs:
+        print("no request timelines in this trace (engine-only events)")
+        return 0
+    print(f"{'rid':<12}{'state':<10}{'ttft_s':>9}{'queue':>7}"
+          f"{'prefill':>9}{'decode_s':>10}{'total_s':>9}  reason")
+    for rid in sorted(reqs):
+        r = reqs[rid]
+        ttft = r["ttft_s"]
+        note = "" if r["complete"] else "  [partial: ring wrapped]"
+        print(f"{rid:<12}{str(r['state']):<10}"
+              f"{'n/a' if ttft is None else format(ttft, '9.4f'):>9}"
+              f"{_share(r['queue_s'], ttft):>7}"
+              f"{_share(r['prefill_s'], ttft):>9}"
+              f"{r['decode_s']:>10.4f}"
+              f"{'n/a' if r['total_s'] is None else format(r['total_s'], '9.4f'):>9}"
+              f"  {r['reason'] or ''}{note}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
