@@ -122,6 +122,42 @@ def trace_report() -> None:
           + (f" (newest: {traces[-1]})" if traces else ""))
 
 
+def perf_report() -> None:
+    """Performance-accounting status (``monitor/perf.py``): per-device
+    memory stats and the resident compiled-program table (name,
+    fingerprint hash, compile/recompile counts, cost-model FLOPs).
+
+    The program table is per-process — a fresh ``ds_report`` CLI run has
+    no engines, so it reports none; call this from inside a serving or
+    training process (or a test) to see the live table."""
+    from deepspeed_tpu.monitor import perf
+
+    print("-" * 60)
+    stats = perf.device_memory_stats()
+    if not stats:
+        print("device memory stats: none exposed by this backend (CPU has "
+              "no allocator stats; TPU reports live/peak HBM here)")
+    else:
+        print(f"{'device':<10}{'kind':<16}{'in_use':>12}{'peak':>12}"
+              f"{'limit':>12}")
+        for s in stats:
+            fmt = lambda k: f"{s[k] / 1e9:.2f}G" if k in s else "n/a"
+            print(f"{s['device']:<10}{s['kind']:<16}"
+                  f"{fmt('bytes_in_use'):>12}{fmt('peak_bytes_in_use'):>12}"
+                  f"{fmt('bytes_limit'):>12}")
+    rows = perf.live_program_table()
+    if not rows:
+        print("compiled programs: none resident in this process")
+        return
+    print(f"{'program':<34}{'fingerprint':<13}{'compiles':>9}"
+          f"{'recompiles':>11}{'calls':>7}  flops/call")
+    for r in rows:
+        flops = "n/a" if r["flops"] is None else f"{r['flops']:.3e}"
+        print(f"{r['name']:<34}{str(r['fingerprint']):<13}"
+              f"{r['compiles']:>9}{r['recompiles']:>11}{r['calls']:>7}"
+              f"  {flops} ({r['cost_source'] or '-'})")
+
+
 def checkpoint_report(ckpt_dir: str) -> int:
     """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
     every save's manifest in a checkpoint dir, print the last-good tag.
@@ -188,6 +224,7 @@ def main(argv=None):
     env_info()
     fault_report()
     trace_report()
+    perf_report()
     op_report()
     return 0
 

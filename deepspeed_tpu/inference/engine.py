@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..monitor.perf import PerfAccounting
 from ..parallel.topology import BATCH_AXES, build_mesh, get_mesh, set_mesh
 from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
@@ -151,6 +152,13 @@ class InferenceEngine:
         self._batch_world = int(np.prod([shape.get(a, 1) for a in BATCH_AXES]))
         self._forward_jit = None
         self._generate_cache: Dict[Any, Any] = {}
+        #: performance accounting (monitor/perf.py): every compiled
+        #: generate bucket registers in the compiled-program registry
+        #: (name, fingerprint, compile count, cost-model FLOPs) — the
+        #: ds_report resident-program table and the compile-storm signal
+        #: (program count exploding = bucketing misconfigured)
+        self.perf = PerfAccounting(
+            scope="inference", n_devices=int(np.prod(mesh.devices.shape)))
         log_dist(f"InferenceEngine: mp={self.mp_world_size}, "
                  f"ep={self.ep_world_size}, dtype={dtype}, "
                  f"quantize={config.quantize}", ranks=[0])
@@ -184,7 +192,8 @@ class InferenceEngine:
 
     def _build_generate(self, batch: int, prompt_len: int, max_new_tokens: int,
                         do_sample: bool, temperature: float, top_k: int, top_p: float,
-                        eos_token_id: Optional[int]):
+                        eos_token_id: Optional[int],
+                        prog_name: str = "generate"):
         module = self.module
         cache_len = prompt_len + max_new_tokens
         compute_dtype = self.compute_dtype
@@ -194,6 +203,9 @@ class InferenceEngine:
         dequant_per_step = getattr(self.config, "dequant_per_step", False)
 
         def generate(qparams, input_ids, attention_mask, rng):
+            # trace-time side effect: runs once per XLA compile of this
+            # shape bucket (the compiled-program registry's compile count)
+            self.perf.note_compile(prog_name)
             if dequant_meta is not None:
                 from ..compression.quantization import dequantize_params
 
@@ -332,11 +344,29 @@ class InferenceEngine:
 
         key = (B, T, max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
         was_cached = key in self._generate_cache
+        # one registry entry PER shape bucket: a program count that keeps
+        # growing after warmup is the compile-storm signal (bucketing off
+        # or misconfigured), while a fingerprint change WITHIN a bucket
+        # would be an impossible recompile and trips the sentinel
+        prog_name = f"generate[b{B},t{T},n{max_new_tokens}]"
         fn = self._generate_cache.get(key)
         if fn is None:
             fn = self._build_generate(B, T, max_new_tokens, do_sample, temperature,
-                                      top_k, top_p, eos_token_id)
+                                      top_k, top_p, eos_token_id,
+                                      prog_name=prog_name)
             self._generate_cache[key] = fn
+        self.perf.observe_call(
+            prog_name,
+            params=self.perf.cached_spec("params", self.params),
+            input_ids=input_ids, attention_mask=attention_mask,
+            sampler=(do_sample, temperature, top_k, top_p, eos_token_id))
+        if was_cached and \
+                self.perf.programs.program(prog_name).cost_pending:
+            # second call on: the lowering is cached by now, so the cost
+            # model comes free (capturing on call one would re-trace)
+            self.perf.capture_cost(prog_name, fn,
+                                   (self.params, input_ids, attention_mask,
+                                    jax.random.PRNGKey(seed)))
         if getattr(self, "_profile_model_time", False):
             import time as _time
 

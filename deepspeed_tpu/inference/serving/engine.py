@@ -64,6 +64,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.layers import paged_cache_index
+from ...monitor.perf import (PerfAccounting, estimate_decode_step_bytes,
+                             estimate_decode_step_flops, param_bytes,
+                             transformer_flops_per_token)
 from ...monitor.tracing import FlightRecorder, Tracer, dump_seq
 from ...utils import fault_injection
 from ...utils.logging import log_dist
@@ -223,6 +226,14 @@ class ServingEngine:
                                self.nb_max, prefix_cache=cfg.prefix_cache,
                                tracer=self.tracer)
         self.metrics = ServingMetrics(blocks_total=cfg.num_blocks)
+        #: performance accounting: compiled-program registry + recompile
+        #: sentinel (the runtime alarm behind the "ONE decode compile"
+        #: invariant), cost-model FLOPs/bytes, MFU/MBU math, and HBM
+        #: watermark sampling. Alarm counters land in the metrics registry.
+        self.perf = PerfAccounting(
+            tracer=self.tracer, metrics=self.metrics.registry,
+            scope="serving",
+            n_devices=int(np.prod(engine.mesh.devices.shape)))
         #: post-mortem capture: armed iff trace_dir is set — watchdog
         #: trips, logit quarantines and DS_FAULT firings each dump the
         #: last trace events + a metrics snapshot there
@@ -235,6 +246,7 @@ class ServingEngine:
 
         kv_dtype = jnp.int8 if engine.config.kv_cache_int8 \
             else engine.compute_dtype
+        self._kv_bytes_per_elem = jnp.dtype(kv_dtype).itemsize
         # committed REPLICATED over the engine mesh: the serving programs
         # declare replicated in_shardings for the pool (TP shards only the
         # params), and a single-device-committed pool would conflict
@@ -718,24 +730,25 @@ class ServingEngine:
             tables = jnp.asarray(self._tables)
             seq_lens = jnp.asarray(self._seq_lens)
             last_tok = jnp.asarray(self._last_tok)
+            corrupt_j = jnp.asarray(corrupt)
 
             def device_step():
                 # chaos point INSIDE the guarded region: a slow/wedged
                 # step is exactly what the watchdog exists for
                 fault_injection.maybe_stall("slow_step", tag="serving_step",
                                             step=step_no)
-                return self._decode_fn(self.engine.params, pool,
-                                       tables, seq_lens, last_tok,
-                                       jnp.asarray(corrupt), rng)
+                return self._decode_dispatch(pool, tables, seq_lens,
+                                             last_tok, corrupt_j, rng)
 
             tr = self.tracer
-            t_dec = time.perf_counter() if tr.enabled else 0.0
+            t_dec = time.perf_counter()
+            was_warm = self._decode_warm
             try:
                 # heartbeat.py's first-beat rule, in-process: the first
                 # decode invocation contains the XLA compile (often far
                 # beyond any sane step budget) and is never watchdog-judged;
                 # steady-state wedges — the r5 outage class — always are
-                if self._decode_warm:
+                if was_warm:
                     toks, bad, self.pool = self._guarded(device_step)
                 else:
                     toks, bad, self.pool = device_step()
@@ -756,11 +769,16 @@ class ServingEngine:
                 self._flight("watchdog_trip", step=step_no, rids=rids,
                              budget_s=self.config.step_watchdog_s)
             else:
+                t_end = time.perf_counter()
                 if tr.enabled:
-                    tr.complete("decode_step", t_dec, time.perf_counter(),
+                    tr.complete("decode_step", t_dec, t_end,
                                 cat="engine",
                                 args={"step": step_no,
                                       "active": len(active)})
+                if was_warm:
+                    # first-beat rule for gauges too: the compile-carrying
+                    # call's wall time would report a garbage MFU/MBU
+                    self._note_decode_perf(t_end - t_dec, tokens=len(active))
                 toks = np.asarray(toks)
                 bad = np.asarray(bad)
                 for slot, req in active:
@@ -807,6 +825,10 @@ class ServingEngine:
         m.chunked_prefill_queue_age_s = 0.0 if not prefilling else \
             time.perf_counter() - min(r.submit_time for r in prefilling)
         m.brownout_active = brownout
+        m.recompiles = self.perf.recompile_total
+        # HBM watermarks: one capability probe, then free on CPU; on TPU
+        # the live/peak bytes ride every snapshot and flight dump
+        m.hbm_bytes_in_use, m.hbm_peak_bytes = self.perf.memory_watermarks()
         if self.monitor is not None and self.config.monitor_every and \
                 self._step_no % self.config.monitor_every == 0:
             self.monitor.write_events(m.to_events(self._step_no))
@@ -890,6 +912,70 @@ class ServingEngine:
             f"decode step exceeded {timeout:.3f}s wall-clock "
             f"(step {self._step_no})")
 
+    # -- performance accounting ----------------------------------------
+
+    def _decode_dispatch(self, pool, tables, seq_lens, last_tok, corrupt,
+                         rng):
+        """The ONE entry to the resident decode program. Every dispatch is
+        fingerprint-observed first (shapes/dtypes/statics): a fingerprint
+        change IS a recompile, so the sentinel fires a `recompile` tracer
+        event + registry counter naming the offending argument before the
+        stall even happens. The first successful call also captures the
+        program's cost model (FLOPs / bytes-accessed) for MFU/MBU."""
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        args = (self.engine.params, pool, tables, seq_lens, last_tok,
+                corrupt, rng)
+        self.perf.observe_call(
+            "decode",
+            params=self.perf.cached_spec("params", self.engine.params),
+            pool=pool, tables=tables, seq_lens=seq_lens, last_tok=last_tok,
+            corrupt=corrupt, rng=rng)
+        out = self._decode_fn(*args)
+        if self.perf.programs.program("decode").cost_pending:
+            # first call (watchdog-exempt): lowering is cached by jax, so
+            # this pays no second trace and no XLA compile
+            self.perf.capture_cost("decode", self._decode_fn, args,
+                                   fallback=self._decode_cost_estimate)
+        return out
+
+    def _decode_cost_estimate(self):
+        """Hand-rolled decode-step cost where the backend has no cost
+        model: every slot computes against the full padded table width —
+        exactly the work the compiled program does."""
+        mcfg = getattr(self.engine.module, "config", None)
+        if mcfg is None:
+            return None
+        B, ctx = self.config.max_batch_size, self.config.max_model_len
+        return {
+            "flops": estimate_decode_step_flops(mcfg, B, ctx),
+            "bytes_accessed": estimate_decode_step_bytes(
+                mcfg, B, ctx, param_bytes(self.engine.params),
+                kv_bytes_per_elem=self._kv_bytes_per_elem),
+        }
+
+    def _note_decode_perf(self, dt_s: float, tokens: int) -> None:
+        """Per-step utilization: decode is bandwidth-bound, so MBU +
+        tokens/sec/chip are the honest gauges (MFU included for
+        completeness); values land in the serving snapshot and every
+        flight dump."""
+        vals = self.perf.on_program_step("decode", dt_s, tokens=tokens)
+        m = self.metrics
+        m.decode_flops_per_step = vals["flops_per_step"]
+        m.decode_bytes_per_step = vals["bytes_per_step"]
+        m.decode_mfu = vals["mfu"]
+        m.decode_mbu = vals["mbu"]
+        m.decode_tokens_per_sec_per_chip = vals["tokens_per_sec_per_chip"]
+
+    def perf_summary(self) -> Dict[str, Any]:
+        """Performance-accounting block for CLI reports and bench
+        artifacts: device peaks, HBM watermarks, the compiled-program
+        table (fingerprints, compile/recompile counts, cost-model FLOPs)
+        and the latest utilization values."""
+        out = self.perf.summary()
+        out["compile_counts"] = dict(self.compile_counts)
+        return out
+
     def _write_table_row(self, req: Request) -> None:
         row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)
         row[:len(req.blocks)] = req.blocks
@@ -938,10 +1024,18 @@ class ServingEngine:
         self._rng, rng = jax.random.split(self._rng)
         tr = self.tracer
         t_pf = time.perf_counter() if tr.enabled else 0.0
-        tok, bad, self.pool = fn(self.engine.params, self.pool,
-                                 jnp.asarray(self._tables[req.slot][None]),
-                                 jnp.asarray(ids), jnp.asarray([L], np.int32),
-                                 rng)
+        pf_args = (self.engine.params, self.pool,
+                   jnp.asarray(self._tables[req.slot][None]),
+                   jnp.asarray(ids), jnp.asarray([L], np.int32), rng)
+        pf_name = f"prefill[{Tb}]"
+        self.perf.observe_call(
+            pf_name,
+            params=self.perf.cached_spec("params", self.engine.params),
+            pool=pf_args[1], table_row=pf_args[2], ids=pf_args[3],
+            length=pf_args[4], rng=rng)
+        tok, bad, self.pool = fn(*pf_args)
+        if self.perf.programs.program(pf_name).cost_pending:
+            self.perf.capture_cost(pf_name, fn, pf_args)
         if tr.enabled:
             tr.complete("prefill", t_pf, time.perf_counter(), cat="engine",
                         args={"rid": req.rid, "tokens": L, "bucket": Tb})
@@ -1058,27 +1152,45 @@ class ServingEngine:
         corrupt_j = jnp.asarray([corrupt])
 
         step_no = self._step_no
+        call_args = (self.engine.params, pool, row_j, ids_j, start_j,
+                     len_j, corrupt_j, rng)
+        # recompile sentinel: the chunked-prefill program is the mixed
+        # step's OTHER resident compile — a fingerprint change here is
+        # the same class of alarm as one on decode
+        self.perf.observe_call(
+            "chunked_prefill",
+            params=self.perf.cached_spec("params", self.engine.params),
+            pool=pool, table_row=row_j, ids=ids_j, start=start_j,
+            length=len_j, corrupt=corrupt_j, rng=rng)
 
         def device_call():
             # chaos point INSIDE the guarded region (the slow_step analog
             # for the mixed step's prefill half)
             fault_injection.maybe_stall("slow_chunk", tag="serving_prefill",
                                         step=step_no)
-            return self._chunked_prefill_fn(self.engine.params, pool,
-                                            row_j, ids_j, start_j, len_j,
-                                            corrupt_j, rng)
+            return self._chunked_prefill_fn(*call_args)
 
         # chunked prefill is the mixed step's OTHER device program, so the
         # step watchdog bounds it exactly like decode (a wedged chunk must
         # fail ITS request and keep the engine serving, not hang every
         # tenant); the first call carries the XLA compile and is exempt
         tr = self.tracer
-        t_ck = time.perf_counter() if tr.enabled else 0.0
+        t_ck = time.perf_counter()
         if self._chunked_warm:
             tok, bad, self.pool = self._guarded(device_call)
+            # warm calls only: the compile-carrying first chunk's wall
+            # time would report a garbage utilization (first-beat rule)
+            self.perf.on_program_step("chunked_prefill",
+                                      time.perf_counter() - t_ck, tokens=n)
         else:
             tok, bad, self.pool = device_call()
             self._chunked_warm = True
+            mcfg = getattr(self.engine.module, "config", None)
+            self.perf.capture_cost(
+                "chunked_prefill", self._chunked_prefill_fn, call_args,
+                fallback=None if mcfg is None else lambda: {
+                    "flops": self._chunk * transformer_flops_per_token(
+                        mcfg, self.config.max_model_len)})
         if tr.enabled:
             tr.complete("prefill_chunk", t_ck, time.perf_counter(),
                         cat="engine",
@@ -1214,6 +1326,7 @@ class ServingEngine:
         def decode(params, pool, tables, seq_lens, last_tok, corrupt, rng):
             # trace-time side effect: runs once per XLA compile
             self.compile_counts["decode"] += 1
+            self.perf.note_compile("decode")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "decode"})
             params = self._dequant(params)
@@ -1246,6 +1359,7 @@ class ServingEngine:
 
         def prefill(params, pool, table_row, ids, length, rng):
             self.compile_counts["prefill"] += 1
+            self.perf.note_compile(f"prefill[{t_bucket}]")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "prefill", "bucket": t_bucket})
             params = self._dequant(params)
@@ -1283,6 +1397,7 @@ class ServingEngine:
         def chunked_prefill(params, pool, table_row, ids, start, length,
                             corrupt, rng):
             self.compile_counts["chunked_prefill"] += 1
+            self.perf.note_compile("chunked_prefill")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "chunked_prefill"})
             params = self._dequant(params)

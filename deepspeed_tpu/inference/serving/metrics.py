@@ -80,6 +80,26 @@ class ServingMetrics:
     #: token budget is starving long prompts
     chunked_prefill_queue_age_s: float = 0.0
     brownout_active: bool = False
+    # -- performance accounting (monitor/perf.py; engine-written each
+    # step). None = not yet captured, or the value needs a device peak /
+    # allocator stats the backend does not expose (CPU) — absent from the
+    # snapshot rather than a fake zero.
+    #: per-call FLOPs of the resident decode step (cost model or estimate)
+    decode_flops_per_step: Optional[float] = None
+    #: per-call bytes-accessed of the resident decode step
+    decode_bytes_per_step: Optional[float] = None
+    #: model FLOPs utilization of the decode step (needs a known peak)
+    decode_mfu: Optional[float] = None
+    #: model BANDWIDTH utilization — decode is bandwidth-bound, this is
+    #: the honest hardware-efficiency gauge for serving
+    decode_mbu: Optional[float] = None
+    decode_tokens_per_sec_per_chip: Optional[float] = None
+    #: recompile-sentinel alarms: resident programs whose argument
+    #: fingerprint changed (each one names the offender in the trace)
+    recompiles: int = 0
+    #: device memory watermarks summed over local devices
+    hbm_bytes_in_use: Optional[int] = None
+    hbm_peak_bytes: Optional[int] = None
     #: the unified registry backing the latency histograms; shared with
     #: anything else that wants to register serving-scoped metrics
     registry: MetricsRegistry = field(default_factory=MetricsRegistry)
@@ -166,7 +186,15 @@ class ServingMetrics:
             "brownout_active": float(self.brownout_active),
             "preemptions": float(self.preemptions),
             "steps": float(self.steps),
+            "recompiles": float(self.recompiles),
         }
+        for key in ("decode_flops_per_step", "decode_bytes_per_step",
+                    "decode_mfu", "decode_mbu",
+                    "decode_tokens_per_sec_per_chip",
+                    "hbm_bytes_in_use", "hbm_peak_bytes"):
+            v = getattr(self, key)
+            if v is not None:
+                out[key] = float(v)
         if self.ttft_hist.count:
             out["ttft_p50_s"] = self.ttft_hist.percentile(0.5)
             out["ttft_p95_s"] = self.ttft_hist.percentile(0.95)

@@ -1,4 +1,7 @@
 from .monitor import MonitorMaster, events_from_scalars  # noqa: F401
+from .perf import (CompiledProgram, PerfAccounting,  # noqa: F401
+                   ProgramRegistry, device_memory_stats, device_peaks,
+                   live_program_table, perf_meta)
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry)
 from .tracing import (FlightRecorder, NULL_TRACER, Tracer,  # noqa: F401

@@ -1,0 +1,591 @@
+"""Performance accounting: compiled-program registry, recompile sentinel,
+cost-model FLOPs/bytes, MFU/MBU, HBM watermarks, and the artifact meta stamp.
+
+PR 5 made *events* observable (spans, flight dumps, metrics registry); this
+layer makes *performance claims* measurable and defensible:
+
+- **Compiled-program registry + recompile sentinel** — every resident
+  jitted program (serving decode / chunked prefill / bucketed prefill,
+  the training step, dense ``generate``) registers an **argument
+  fingerprint** (shapes / dtypes / statics). A later call whose
+  fingerprint differs IS a recompile (XLA keys its cache on exactly these),
+  so the sentinel diffs the fingerprints and raises a runtime alarm —
+  a tracer event + a registry counter — **naming the offending argument**
+  and how it changed. The serving layer's "ONE decode compile" invariant
+  stops being a test-only assertion and becomes something a production
+  run screams about.
+- **Cost-model accounting** — ``jitfn.lower(*args).cost_analysis()``
+  captured once per program (the lowering is cached by jax, so this pays
+  no second trace and no XLA compile), with a hand-rolled transformer
+  FLOPs estimate as the fallback where the backend has no cost model.
+  Combined with step wall times this yields **MFU** (training / prefill:
+  compute-bound) and **MBU + tokens/sec/chip** (decode: bandwidth-bound).
+- **Device memory watermarks** — ``device.memory_stats()`` live/peak HBM
+  bytes, graceful no-op on backends (CPU) that expose none.
+- **Artifact meta stamp** — :func:`perf_meta`: git sha, jax/jaxlib
+  versions, device kind/count, host. Every ``ds_bench`` artifact carries
+  it so ``tools/perfdiff.py`` can refuse apples-to-oranges comparisons.
+
+A ``ProgramRegistry`` is cheap enough for hot paths: one dict-equality
+check per dispatch (the fingerprints are small flat dicts of strings) —
+the decode step pays ~tens of microseconds against a multi-millisecond
+step, and the tracing-overhead bar in ``SERVING_r*.json`` keeps that
+honest.
+"""
+
+import hashlib
+import os
+import socket
+import subprocess
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# device capability table (per chip)
+# ---------------------------------------------------------------------------
+
+#: peak dense (bf16/fp16) FLOPs/s and peak HBM bandwidth (bytes/s) PER CHIP,
+#: keyed by a substring of ``device.device_kind``. Longest key wins, so
+#: "TPU v5 lite" matches before a hypothetical "TPU v5". Sources: published
+#: per-chip specs (v5e aka "v5 lite": 197 bf16 TFLOPs, 819 GB/s).
+DEVICE_PEAKS: Dict[str, Tuple[float, float]] = {
+    "TPU v2": (46e12, 700e9),
+    "TPU v3": (123e12, 900e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),
+    "TPU v6e": (918e12, 1640e9),
+}
+
+
+def device_peaks(device_kind: Optional[str]
+                 ) -> Tuple[Optional[float], Optional[float]]:
+    """(peak_flops_per_s, peak_hbm_bytes_per_s) per chip for a
+    ``device.device_kind`` string; (None, None) when unknown (CPU, new
+    hardware) — utilization gauges are then omitted rather than wrong."""
+    if not device_kind:
+        return (None, None)
+    best = None
+    for key, peaks in DEVICE_PEAKS.items():
+        if key in device_kind and (best is None or len(key) > len(best[0])):
+            best = (key, peaks)
+    return best[1] if best else (None, None)
+
+
+# ---------------------------------------------------------------------------
+# argument fingerprints
+# ---------------------------------------------------------------------------
+
+def _leaf_spec(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    return repr(x)
+
+
+def spec(x: Any) -> str:
+    """One argument's fingerprint component: ``dtype[shape]`` for arrays,
+    a leaf-spec summary for pytrees, ``repr`` for statics — exactly the
+    properties jax keys its compilation cache on, so *fingerprint changed*
+    ⟺ *this call retraced/recompiled*."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return _leaf_spec(x)
+    if isinstance(x, (list, tuple, dict)) or hasattr(x, "__dataclass_fields__"):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(x)
+        if leaves and any(hasattr(l, "shape") for l in leaves):
+            specs = [_leaf_spec(l) for l in leaves]
+            # collapse runs of identical leaves ("f32[64,64] x48") so big
+            # pytrees fingerprint compactly AND compare fast
+            out: List[str] = []
+            run = 1
+            for i in range(1, len(specs) + 1):
+                if i < len(specs) and specs[i] == specs[i - 1]:
+                    run += 1
+                    continue
+                out.append(specs[i - 1] if run == 1
+                           else f"{specs[i - 1]} x{run}")
+                run = 1
+            return f"pytree[{len(specs)}: " + "; ".join(out) + "]"
+    return repr(x)
+
+
+def fingerprint(**args: Any) -> Dict[str, str]:
+    """Named-argument fingerprint of one program call."""
+    return {name: spec(v) for name, v in args.items()}
+
+
+def fingerprint_diff(old: Dict[str, str], new: Dict[str, str]
+                     ) -> Dict[str, Tuple[Optional[str], Optional[str]]]:
+    """{arg: (before, after)} for every argument that changed (None =
+    argument added/removed)."""
+    out: Dict[str, Tuple[Optional[str], Optional[str]]] = {}
+    for k in {**old, **new}:
+        if old.get(k) != new.get(k):
+            out[k] = (old.get(k), new.get(k))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compiled-program registry + recompile sentinel
+# ---------------------------------------------------------------------------
+
+class CompiledProgram:
+    """One resident jitted program's accounting record."""
+
+    __slots__ = ("name", "fingerprint", "compiles", "calls", "recompiles",
+                 "flops", "bytes_accessed", "cost_source", "cost_attempted")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.fingerprint: Optional[Dict[str, str]] = None
+        self.compiles = 0      # XLA compiles (trace-time counter hook)
+        self.calls = 0         # dispatches observed
+        self.recompiles = 0    # sentinel alarms: fingerprint changed
+        self.flops: Optional[float] = None           # per call
+        self.bytes_accessed: Optional[float] = None  # per call
+        self.cost_source: Optional[str] = None  # "cost_model" | "estimate"
+        #: capture tried (even unsuccessfully): a backend with no cost
+        #: model AND no fallback must pay the lowering walk once, not on
+        #: every hot-path dispatch
+        self.cost_attempted = False
+
+    @property
+    def cost_pending(self) -> bool:
+        return not self.cost_attempted
+
+    @property
+    def fingerprint_hash(self) -> Optional[str]:
+        if self.fingerprint is None:
+            return None
+        blob = ";".join(f"{k}={v}" for k, v in
+                        sorted(self.fingerprint.items()))
+        return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+    def row(self) -> Dict[str, Any]:
+        return {"name": self.name, "fingerprint": self.fingerprint_hash,
+                "compiles": self.compiles, "recompiles": self.recompiles,
+                "calls": self.calls, "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "cost_source": self.cost_source}
+
+
+#: every live ProgramRegistry in the process, for ``ds_report``'s resident
+#: compiled-program table (weak: the report must never pin a dropped engine)
+_live_registries: "weakref.WeakSet[ProgramRegistry]" = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+class ProgramRegistry:
+    """Get-or-create registry of :class:`CompiledProgram` records with the
+    recompile sentinel on :meth:`observe_call`."""
+
+    def __init__(self, tracer=None, metrics=None, scope: str = ""):
+        self.scope = scope
+        self.tracer = tracer
+        self.metrics = metrics  # MetricsRegistry for the alarm counters
+        self.programs: Dict[str, CompiledProgram] = {}
+        with _live_lock:
+            _live_registries.add(self)
+
+    def program(self, name: str) -> CompiledProgram:
+        prog = self.programs.get(name)
+        if prog is None:
+            prog = self.programs[name] = CompiledProgram(name)
+        return prog
+
+    def note_compile(self, name: str) -> None:
+        """Trace-time hook: call from inside the traced function body (it
+        runs exactly once per XLA compile, the ``compile_counts``
+        pattern)."""
+        self.program(name).compiles += 1
+
+    def observe_call(self, name: str, fp: Dict[str, str]
+                     ) -> Optional[Dict[str, Tuple[Optional[str],
+                                                   Optional[str]]]]:
+        """Record one dispatch. First call registers the fingerprint; a
+        later call with a DIFFERENT fingerprint is a recompile — the
+        sentinel fires (tracer event + metrics counter + warning log)
+        naming every argument whose spec changed, and returns the diff
+        (None = fingerprint stable)."""
+        prog = self.program(name)
+        prog.calls += 1
+        if prog.fingerprint is None:
+            prog.fingerprint = fp
+            return None
+        if fp == prog.fingerprint:
+            return None
+        diff = fingerprint_diff(prog.fingerprint, fp)
+        prog.fingerprint = fp
+        prog.recompiles += 1
+        offenders = sorted(diff)
+        if self.metrics is not None:
+            self.metrics.counter("recompiles", program=name).inc()
+        if self.tracer is not None and getattr(self.tracer, "enabled", False):
+            self.tracer.instant(
+                "recompile", cat="perf",
+                args={"program": name, "args": offenders,
+                      "changed": {k: [diff[k][0], diff[k][1]]
+                                  for k in offenders}})
+        changes = "; ".join(f"{k}: {diff[k][0]} -> {diff[k][1]}"
+                            for k in offenders)
+        logger.warning(
+            f"perf sentinel: program {self.scope + '/' if self.scope else ''}"
+            f"{name} RECOMPILED (call {prog.calls}) — argument(s) changed: "
+            f"{changes}. Resident programs are supposed to see one shape "
+            f"forever; this compile stalls the serving/training loop.")
+        return diff
+
+    def set_cost(self, name: str, flops: Optional[float],
+                 bytes_accessed: Optional[float], source: str) -> None:
+        prog = self.program(name)
+        prog.flops = flops
+        prog.bytes_accessed = bytes_accessed
+        prog.cost_source = source
+
+    @property
+    def recompile_total(self) -> int:
+        return sum(p.recompiles for p in self.programs.values())
+
+    def table(self) -> List[Dict[str, Any]]:
+        rows = []
+        for name in sorted(self.programs):
+            row = self.programs[name].row()
+            if self.scope:
+                row["name"] = f"{self.scope}/{name}"
+            rows.append(row)
+        return rows
+
+
+def live_program_table() -> List[Dict[str, Any]]:
+    """The resident compiled-program table across every live registry in
+    this process (what ``ds_report`` prints)."""
+    with _live_lock:
+        regs = list(_live_registries)
+    rows: List[Dict[str, Any]] = []
+    for reg in regs:
+        rows.extend(reg.table())
+    return sorted(rows, key=lambda r: r["name"])
+
+
+# ---------------------------------------------------------------------------
+# cost-model capture + hand-rolled transformer estimates
+# ---------------------------------------------------------------------------
+
+def cost_analysis_of(jitfn, *args) -> Optional[Dict[str, float]]:
+    """``{"flops", "bytes_accessed"}`` from the XLA cost model of a jitted
+    function's lowering, or None where the backend offers no cost model.
+
+    ``jitfn.lower(*args)`` reuses jax's cached lowering for already-called
+    shapes — no second trace of the Python body (trace-time counters like
+    ``compile_counts`` stay untouched) and no XLA compile."""
+    try:
+        ca = jitfn.lower(*args).cost_analysis()
+        if isinstance(ca, (list, tuple)):  # per-partition variants
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        flops = float(ca.get("flops", -1.0))
+        if flops <= 0:
+            return None
+        out = {"flops": flops}
+        if ca.get("bytes accessed", 0):
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        return out
+    except Exception as e:  # no cost model is a degraded mode, not an error
+        logger.debug(f"perf: cost_analysis unavailable: "
+                     f"{type(e).__name__}: {e}")
+        return None
+
+
+def transformer_flops_per_token(cfg, context_len: int) -> float:
+    """Hand-rolled dense-transformer FLOPs for ONE decoded token against a
+    ``context_len``-wide KV context (the fallback when the backend has no
+    cost model). Counts matmuls at 2·M·N·K: qkv/o projections, the
+    (gate/up/down when ``intermediate_size`` differs, else 2-matmul) MLP,
+    QKᵀ + AV attention over ``context_len`` keys, and the LM head.
+    Embedding gathers are free."""
+    L = int(getattr(cfg, "num_hidden_layers", getattr(cfg, "n_layer", 0)))
+    h = int(getattr(cfg, "hidden_size", getattr(cfg, "n_embd", 0)))
+    H = int(getattr(cfg, "num_attention_heads", getattr(cfg, "n_head", 1)))
+    Hkv = int(getattr(cfg, "num_key_value_heads", H) or H)
+    D = int(getattr(cfg, "head_dim", max(1, h // max(1, H))))
+    V = int(getattr(cfg, "vocab_size", 0))
+    inter = getattr(cfg, "intermediate_size", None)
+    if inter:  # llama-family: gate + up + down
+        mlp = 2 * h * int(inter) * 3
+    else:      # gpt2-family: fc(4h) + proj
+        mlp = 2 * h * (4 * h) * 2
+    qkv = 2 * h * (H * D + 2 * Hkv * D)
+    o = 2 * (H * D) * h
+    attn = 2 * 2 * H * D * int(context_len)
+    return float(L * (qkv + o + mlp + attn) + 2 * h * V)
+
+
+def estimate_decode_step_flops(cfg, batch: int, context_len: int) -> float:
+    """Fallback FLOPs of one resident decode step: the program computes
+    every one of its ``batch`` slots (padding included — that IS the
+    hardware work) against a ``context_len``-deep context."""
+    return batch * transformer_flops_per_token(cfg, context_len)
+
+
+def param_bytes(params) -> int:
+    import jax
+
+    return sum(int(getattr(l, "nbytes", 0) or 0)
+               for l in jax.tree_util.tree_leaves(params))
+
+
+def estimate_decode_step_bytes(cfg, batch: int, context_len: int,
+                               params_nbytes: int,
+                               kv_bytes_per_elem: int = 2) -> float:
+    """Fallback bytes-accessed of one decode step: weights streamed once
+    plus the KV context read per slot — decode's two bandwidth sinks."""
+    L = int(getattr(cfg, "num_hidden_layers", getattr(cfg, "n_layer", 0)))
+    H = int(getattr(cfg, "num_attention_heads", getattr(cfg, "n_head", 1)))
+    Hkv = int(getattr(cfg, "num_key_value_heads", H) or H)
+    h = int(getattr(cfg, "hidden_size", getattr(cfg, "n_embd", 0)))
+    D = int(getattr(cfg, "head_dim", max(1, h // max(1, H))))
+    kv = batch * L * 2 * Hkv * D * int(context_len) * kv_bytes_per_elem
+    return float(params_nbytes + kv)
+
+
+# ---------------------------------------------------------------------------
+# device memory watermarks
+# ---------------------------------------------------------------------------
+
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_alloc_size")
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Live/peak HBM per local device, ``[]`` where the backend exposes no
+    allocator stats (CPU) — watermark consumers degrade to absent fields,
+    never fake zeros."""
+    import jax
+
+    out = []
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        rec: Dict[str, Any] = {"device": str(d.id),
+                               "kind": getattr(d, "device_kind", "?")}
+        for k in _MEM_KEYS:
+            if k in stats:
+                rec[k] = int(stats[k])
+        out.append(rec)
+    return out
+
+
+def hbm_watermarks() -> Tuple[Optional[int], Optional[int]]:
+    """(bytes_in_use, peak_bytes_in_use) summed over local devices; (None,
+    None) on backends without allocator stats."""
+    stats = device_memory_stats()
+    if not stats:
+        return (None, None)
+    return (sum(s.get("bytes_in_use", 0) for s in stats),
+            sum(s.get("peak_bytes_in_use", 0) for s in stats))
+
+
+# ---------------------------------------------------------------------------
+# artifact meta stamp
+# ---------------------------------------------------------------------------
+
+def git_sha(repo_root: Optional[str] = None) -> Optional[str]:
+    root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=root, capture_output=True, text=True,
+                             timeout=10)
+        sha = out.stdout.strip()
+        return sha or None
+    except Exception:
+        return None
+
+
+def perf_meta() -> Dict[str, Any]:
+    """The provenance block every ``ds_bench`` artifact carries: enough to
+    refuse apples-to-oranges perf comparisons (``tools/perfdiff.py``) and
+    to answer "what exactly produced this number?" months later."""
+    import jax
+    import jaxlib
+
+    meta: Dict[str, Any] = {
+        "schema": 1,
+        "git_sha": git_sha(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "host": socket.gethostname(),
+        "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    try:
+        devs = jax.devices()
+        meta["platform"] = devs[0].platform
+        meta["device_kind"] = devs[0].device_kind
+        meta["device_count"] = len(devs)
+    except Exception as e:
+        meta["platform"] = f"unavailable ({type(e).__name__})"
+        meta["device_kind"] = None
+        meta["device_count"] = 0
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# PerfAccounting: the engine-side bundle
+# ---------------------------------------------------------------------------
+
+class PerfAccounting:
+    """Everything one engine needs, bundled: a scoped
+    :class:`ProgramRegistry`, the device's peak table, per-program
+    utilization math, cached pytree fingerprints for stable-identity args
+    (params), and watermark sampling with the backend capability probed
+    once."""
+
+    def __init__(self, tracer=None, metrics=None, scope: str = "",
+                 n_devices: int = 1, device_kind: Optional[str] = None):
+        if device_kind is None:
+            try:
+                import jax
+
+                device_kind = jax.devices()[0].device_kind
+            except Exception:
+                device_kind = None
+        self.device_kind = device_kind
+        self.n_devices = max(1, int(n_devices))
+        self.peak_flops, self.peak_hbm_bw = device_peaks(device_kind)
+        self.programs = ProgramRegistry(tracer=tracer, metrics=metrics,
+                                        scope=scope)
+        self._spec_memo: Dict[str, Tuple[int, str]] = {}
+        #: None = unprobed, False = backend has no allocator stats
+        self._mem_capable: Optional[bool] = None
+        #: last on_program_step utilization values, per program
+        self.last: Dict[str, Dict[str, Optional[float]]] = {}
+
+    # -- fingerprints ---------------------------------------------------
+
+    def cached_spec(self, key: str, tree: Any) -> str:
+        """Pytree spec memoized on object identity — params keep one
+        object across a run, so the per-call cost is one ``id()``
+        compare instead of an O(leaves) walk."""
+        memo = self._spec_memo.get(key)
+        if memo is not None and memo[0] == id(tree):
+            return memo[1]
+        s = spec(tree)
+        self._spec_memo[key] = (id(tree), s)
+        return s
+
+    def observe_call(self, name: str, **args: Any):
+        return self.programs.observe_call(name, fingerprint(**args))
+
+    def note_compile(self, name: str) -> None:
+        self.programs.note_compile(name)
+
+    # -- cost capture ---------------------------------------------------
+
+    def capture_cost(self, name: str, jitfn, args: Tuple[Any, ...],
+                     fallback: Optional[Callable[[], Optional[Dict[str, float]]]]
+                     = None) -> None:
+        """Capture a program's per-call FLOPs / bytes-accessed, once: XLA
+        cost model first, the hand-rolled estimate as fallback. Never
+        raises — accounting must not take down the engine it measures.
+        A FAILED capture is latched too (``cost_attempted``): retrying
+        the lowering walk per dispatch would tax exactly the hot path
+        this layer promises not to."""
+        prog = self.programs.program(name)
+        if prog.cost_attempted:
+            return
+        prog.cost_attempted = True
+        cost = cost_analysis_of(jitfn, *args)
+        source = "cost_model"
+        if cost is None and fallback is not None:
+            try:
+                cost = fallback()
+            except Exception as e:
+                logger.debug(f"perf: flops fallback for {name} failed: {e}")
+                cost = None
+            source = "estimate"
+        if cost is None:
+            return
+        self.programs.set_cost(name, cost.get("flops"),
+                               cost.get("bytes_accessed"), source)
+
+    # -- utilization ----------------------------------------------------
+
+    def on_program_step(self, name: str, dt_s: float,
+                        tokens: Optional[int] = None
+                        ) -> Dict[str, Optional[float]]:
+        """Fold one timed dispatch of ``name`` into utilization gauges:
+        MFU = flops / (dt · peak_flops · chips), MBU = bytes / (dt ·
+        peak_bw · chips); both None until the cost is captured or where
+        the device peak is unknown (CPU). ``tokens`` adds
+        tokens/sec/chip."""
+        prog = self.programs.programs.get(name)
+        vals: Dict[str, Optional[float]] = {
+            "flops_per_step": prog.flops if prog else None,
+            "bytes_per_step": prog.bytes_accessed if prog else None,
+            "mfu": None, "mbu": None, "flops_per_sec": None,
+            "tokens_per_sec_per_chip": None,
+        }
+        if dt_s > 0 and prog is not None:
+            if prog.flops:
+                vals["flops_per_sec"] = prog.flops / dt_s
+                if self.peak_flops:
+                    vals["mfu"] = prog.flops / (
+                        dt_s * self.peak_flops * self.n_devices)
+            if prog.bytes_accessed and self.peak_hbm_bw:
+                vals["mbu"] = prog.bytes_accessed / (
+                    dt_s * self.peak_hbm_bw * self.n_devices)
+            if tokens is not None:
+                vals["tokens_per_sec_per_chip"] = tokens / (
+                    dt_s * self.n_devices)
+        self.last[name] = vals
+        return vals
+
+    # -- watermarks -----------------------------------------------------
+
+    def memory_watermarks(self) -> Tuple[Optional[int], Optional[int]]:
+        """(live, peak) HBM bytes; one capability probe, then a cheap
+        no-op forever on backends (CPU) without allocator stats."""
+        if self._mem_capable is False:
+            return (None, None)
+        live, peak = hbm_watermarks()
+        if self._mem_capable is None:
+            self._mem_capable = live is not None
+        return (live, peak)
+
+    # -- reporting ------------------------------------------------------
+
+    @property
+    def recompile_total(self) -> int:
+        return self.programs.recompile_total
+
+    def summary(self) -> Dict[str, Any]:
+        """One JSON-able block for CLI reports and bench artifacts."""
+        live, peak = self.memory_watermarks()
+        return {
+            "device_kind": self.device_kind,
+            "n_devices": self.n_devices,
+            "peak_flops_per_chip": self.peak_flops,
+            "peak_hbm_bytes_per_s_per_chip": self.peak_hbm_bw,
+            "hbm_bytes_in_use": live,
+            "hbm_peak_bytes": peak,
+            "programs": self.programs.table(),
+            "utilization": {k: dict(v) for k, v in self.last.items()},
+        }

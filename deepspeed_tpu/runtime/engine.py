@@ -409,6 +409,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         # block or the DS_TRACE_DIR env var (monitor/tracing.py). The
         # registry's log-bucket step-latency histogram flows to every
         # monitor backend through MonitorMaster.write_registry.
+        from ..monitor.perf import PerfAccounting
         from ..monitor.registry import MetricsRegistry
         from ..monitor.tracing import (ENV_TRACE_DIR, FlightRecorder,
                                        Tracer)
@@ -416,10 +417,26 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.registry = MetricsRegistry()
         self._step_hist = self.registry.histogram("train_batch_s",
                                                   lo=1e-4, hi=4e3)
+        #: performance accounting (monitor/perf.py): the compiled train
+        #: step registers an argument fingerprint (recompile sentinel —
+        #: curriculum/data shape drift shows up as a NAMED alarm, not a
+        #: mystery stall) and captures cost-model FLOPs once, yielding the
+        #: train_mfu / train_tflops_per_chip gauges in the registry.
+        self.perf = PerfAccounting(
+            tracer=None,  # set below once the tracer exists
+            metrics=self.registry, scope="train",
+            n_devices=int(np.prod(self.mesh.devices.shape)))
+        #: state fingerprint computed once: the TrainState's shapes are
+        #: fixed by construction (replace() preserves them) while its
+        #: object identity changes every step — re-walking a large param
+        #: tree per step would tax the hot loop for a spec that cannot
+        #: change. Batch + rng stay fingerprinted per call.
+        self._state_spec: Optional[str] = None
         tcfg = self._config.tracing
         trace_dir = tcfg.dir or os.environ.get(ENV_TRACE_DIR)
         self.tracer = Tracer(capacity=tcfg.capacity,
                              enabled=bool(tcfg.enabled or trace_dir))
+        self.perf.programs.tracer = self.tracer
         self.flight = None
         if trace_dir:
             self.flight = FlightRecorder(
@@ -598,6 +615,9 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             return grads, loss
 
         def train_step(state: TrainState, batch, rng):
+            # trace-time side effect: runs once per XLA compile (the
+            # compiled-program registry's compile count)
+            self.perf.note_compile("train_step")
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             # PLD keep-rate for THIS step (reference passes pld state into
             # forward each step, engine.py:1636)
@@ -692,6 +712,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         grad_fn = jax.grad(compute_loss, has_aux=True)
 
         def grad_step(params, batch, rng, scale):
+            self.perf.note_compile("grad_step")
             if gas > 1:
                 rngs = jax.random.split(rng, gas)
 
@@ -860,6 +881,19 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             fp = self._config.flops_profiler
             profiling = (fp.enabled and self.global_steps == fp.profile_step)
             t0 = time.perf_counter() if profiling else None
+            # recompile sentinel: the train step is a RESIDENT program —
+            # a fingerprint change (curriculum seqlen, drifting data
+            # shapes) is a compile stall and gets a named alarm. The
+            # state spec is computed once (shapes fixed by construction).
+            from ..monitor import perf as _perf
+
+            if self._state_spec is None:
+                self._state_spec = _perf.spec(self.state)
+            self.perf.programs.observe_call(
+                "train_step", {"state": self._state_spec,
+                               "batch": _perf.spec(batch),
+                               "rng": _perf.spec(step_rng)})
+            warm = not self.perf.programs.program("train_step").cost_pending
             # span covers the fused fwd/bwd/optimizer DISPATCH (XLA runs
             # the three as one program; wall_clock_breakdown timers remain
             # the per-phase estimate) — forcing the loss here would fence
@@ -870,6 +904,14 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             if tr.enabled:
                 tr.complete("train_step", t_step0, time.perf_counter(),
                             cat="train", args={"step": self.global_steps})
+            if not warm:
+                # once, after the compile-carrying first call: the cached
+                # lowering yields the cost model without a second trace;
+                # the jaxpr-walk flops profiler is the fallback
+                self.perf.capture_cost(
+                    "train_step", self._train_step,
+                    (self.state, batch, step_rng),
+                    fallback=self._train_flops_estimate(batch, step_rng))
             if profiling:
                 float(loss)  # device fence so the measured latency is real
                 self._print_flops_profile(batch, step_rng,
@@ -898,7 +940,21 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.tput_timer.stop()
         if self.wall_clock_breakdown:
             self.timers("train_batch").stop()
-        self._step_hist.observe(time.perf_counter() - t_batch0)
+        dt_batch = time.perf_counter() - t_batch0
+        self._step_hist.observe(dt_batch)
+        if not self._offload and \
+                self.perf.programs.program("train_step").cost_source \
+                is not None and self.global_steps > 1:
+            # MFU over the train_batch wall clock: in steady state the
+            # async dispatch backpressures on the previous step, so wall
+            # time per batch ≈ device time per step; the compile-carrying
+            # first step is excluded (first-beat rule)
+            vals = self.perf.on_program_step("train_step", dt_batch)
+            if vals["mfu"] is not None:
+                self.registry.gauge("train_mfu").set(vals["mfu"])
+            if vals["flops_per_sec"]:
+                self.registry.gauge("train_tflops_per_chip").set(
+                    vals["flops_per_sec"] / 1e12 / self.perf.n_devices)
         if tr.enabled:
             tr.complete("train_batch", t_batch0, time.perf_counter(),
                         cat="train", args={"step": self.global_steps - 1})
@@ -921,6 +977,19 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         from ..checkpoint.manifest import prune_checkpoints
 
         prune_checkpoints(self._elastic_ckpt_dir, keep=keep)
+
+    def _train_flops_estimate(self, shaped_batch, rng):
+        """Fallback FLOPs source for backends without an XLA cost model: a
+        jaxpr walk of the raw train step (the flops profiler's graph
+        accounting — counts every dot/conv/elementwise primitive)."""
+        def estimate():
+            from ..profiling.flops_profiler.profiler import profile_fn
+
+            prof = profile_fn(self._train_step_fn, self.state, shaped_batch,
+                              rng)
+            return {"flops": float(prof.total_flops())}
+
+        return estimate
 
     def _print_flops_profile(self, shaped_batch, rng, step_time_s):
         """Flops-profiler hook (reference ``engine.py:1615,1634``: start at

@@ -1,0 +1,159 @@
+"""Perf-regression gate (``tools/perfdiff.py``): tolerance bands by
+metric direction, never-increase compile counters, the absolute tracing
+overhead bar, and the cross-device refusal."""
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perfdiff  # noqa: E402
+
+
+META = {"schema": 1, "git_sha": "abc1234", "jax": "0.4.37",
+        "jaxlib": "0.4.36", "host": "box", "platform": "cpu",
+        "device_kind": "cpu", "device_count": 1,
+        "wall_time": "2026-08-03T00:00:00"}
+
+BASE = {
+    "benchmark": "serving_prefix_caching",
+    "meta": META,
+    "ttft_cold_s": {"p50": 0.0222, "p95": 0.0304},
+    "ttft_hit_s": {"p50": 0.0051, "p95": 0.007},
+    "ttft_speedup_p50": 4.36,
+    "tokens_per_sec_compute_run": 1270.24,
+    "prefix_hit_rate": 0.4243,
+    "compile_counts": {"decode": 1, "prefill": 0, "chunked_prefill": 1},
+    "perf": {"recompile_counts": {"decode": 0, "chunked_prefill": 0},
+             "mfu": None, "mbu": None},
+    "tracing_overhead": {"overhead_pct": -2.24},
+}
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def run(tmp_path, base, cand, *extra):
+    return perfdiff.main([_write(tmp_path, "base.json", base),
+                          _write(tmp_path, "cand.json", cand), *extra])
+
+
+def test_self_compare_exits_zero(tmp_path, capsys):
+    assert run(tmp_path, BASE, BASE) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+
+def test_baseline_flag_form(tmp_path):
+    b = _write(tmp_path, "b.json", BASE)
+    c = _write(tmp_path, "c.json", BASE)
+    assert perfdiff.main(["--baseline", b, c]) == 0
+
+
+def test_regressed_latency_exits_nonzero(tmp_path, capsys):
+    cand = copy.deepcopy(BASE)
+    cand["ttft_hit_s"]["p50"] = 0.0051 * 1.5   # +50% > the 25% band
+    assert run(tmp_path, BASE, cand) == 1
+    err = capsys.readouterr().err
+    assert "ttft_hit_s.p50" in err
+
+
+def test_within_band_passes_and_improvement_passes(tmp_path):
+    cand = copy.deepcopy(BASE)
+    cand["ttft_hit_s"]["p50"] = 0.0051 * 1.2   # +20% < the 25% band
+    cand["ttft_cold_s"]["p50"] = 0.0222 * 0.5  # faster is never a regression
+    cand["tokens_per_sec_compute_run"] = 1270.24 * 2
+    assert run(tmp_path, BASE, cand) == 0
+
+
+def test_regressed_throughput_exits_nonzero(tmp_path):
+    cand = copy.deepcopy(BASE)
+    cand["tokens_per_sec_compute_run"] = 1270.24 * 0.5
+    assert run(tmp_path, BASE, cand) == 1
+
+
+def test_per_metric_tolerance_override(tmp_path):
+    cand = copy.deepcopy(BASE)
+    cand["ttft_hit_s"]["p50"] = 0.0051 * 1.2   # +20%
+    assert run(tmp_path, BASE, cand, "--tol", "ttft_hit_s.p50=0.1") == 1
+    assert run(tmp_path, BASE, cand, "--tol", "ttft_hit_s.p50=0.3") == 0
+
+
+def test_compile_count_increase_is_always_a_regression(tmp_path, capsys):
+    cand = copy.deepcopy(BASE)
+    cand["compile_counts"]["decode"] = 2       # the lost invariant
+    assert run(tmp_path, BASE, cand) == 1
+    assert "compile_counts.decode" in capsys.readouterr().err
+
+
+def test_recompile_sentinel_count_gates(tmp_path):
+    cand = copy.deepcopy(BASE)
+    cand["perf"]["recompile_counts"]["decode"] = 3
+    assert run(tmp_path, BASE, cand) == 1
+
+
+def test_tracing_overhead_absolute_bar(tmp_path):
+    # the baseline is NEGATIVE (tracing measured faster): only the
+    # absolute <=5% bar gates, not a multiplicative band off -2.24
+    cand = copy.deepcopy(BASE)
+    cand["tracing_overhead"]["overhead_pct"] = 4.2
+    assert run(tmp_path, BASE, cand) == 0
+    cand["tracing_overhead"]["overhead_pct"] = 7.5
+    assert run(tmp_path, BASE, cand) == 1
+
+
+def test_cross_device_refused_without_force(tmp_path, capsys):
+    cand = copy.deepcopy(BASE)
+    cand["meta"] = dict(META, device_kind="TPU v5 lite", platform="tpu")
+    assert run(tmp_path, BASE, cand) == 2
+    assert "cross-device" in capsys.readouterr().err
+    assert run(tmp_path, BASE, cand, "--force") == 0
+
+
+def test_missing_meta_refused_without_force(tmp_path, capsys):
+    legacy = {k: v for k, v in BASE.items() if k != "meta"}
+    assert run(tmp_path, legacy, BASE) == 2
+    assert "meta" in capsys.readouterr().err
+    assert run(tmp_path, legacy, BASE, "--force") == 0
+
+
+def test_device_count_mismatch_refused(tmp_path):
+    cand = copy.deepcopy(BASE)
+    cand["meta"] = dict(META, device_count=8)
+    assert run(tmp_path, BASE, cand) == 2
+
+
+def test_bad_usage_and_bad_json(tmp_path):
+    assert perfdiff.main([_write(tmp_path, "only.json", BASE)]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    assert perfdiff.main([str(bad), _write(tmp_path, "ok.json", BASE)]) == 2
+
+
+def test_committed_artifact_self_compares_clean():
+    """The committed SERVING_r09.json must gate green against itself —
+    the exact command the verify skill runs."""
+    art = os.path.join(REPO, "SERVING_r09.json")
+    if not os.path.exists(art):
+        pytest.skip("SERVING_r09.json not committed yet")
+    assert perfdiff.main(["--baseline", art, art]) == 0
+
+
+def test_classify_directions():
+    assert perfdiff.classify("ttft_hit_s.p50") == "lower"
+    assert perfdiff.classify("ttft_speedup_p50") == "higher"  # speedup wins
+    assert perfdiff.classify("tokens_per_sec_compute_run") == "higher"
+    assert perfdiff.classify("compile_counts.decode") == "never_increase"
+    assert perfdiff.classify("perf.recompile_counts.decode") \
+        == "never_increase"
+    assert perfdiff.classify("tracing_overhead.overhead_pct") == "abs_bar"
+    assert perfdiff.classify("meta.device_count") is None
+    assert perfdiff.classify("prefix_hits") is None

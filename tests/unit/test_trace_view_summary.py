@@ -1,0 +1,146 @@
+"""``tools/trace_view.py --summary``: cross-file aggregation (engine time
+share, xla-compile and recompile-sentinel events, request phase totals,
+worst-N TTFT with file attribution), plus the multi-file guard rails."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import trace_view  # noqa: E402
+from deepspeed_tpu.monitor.tracing import FlightRecorder, Tracer  # noqa: E402
+
+
+def _request(tracer, rid, t0, queue_s, prefill_s, decode_s, ttft):
+    tracer.complete(f"phase:queue", t0, t0 + queue_s, cat="request",
+                    args={"rid": rid})
+    tracer.complete(f"phase:prefill", t0 + queue_s, t0 + queue_s + prefill_s,
+                    cat="request", args={"rid": rid})
+    tracer.complete(f"phase:decode", t0 + queue_s + prefill_s,
+                    t0 + queue_s + prefill_s + decode_s, cat="request",
+                    args={"rid": rid})
+    tracer.complete("request", t0, t0 + queue_s + prefill_s + decode_s,
+                    cat="request",
+                    args={"rid": rid, "ttft_s": ttft, "state": "finished",
+                          "reason": "length", "preemptions": 0})
+
+
+def _trace_file(path, rids_ttft, with_recompile=False):
+    tr = Tracer(capacity=256)
+    tr.instant("xla_compile", cat="engine", args={"kind": "decode"})
+    tr.complete("decode_step", 1.0, 1.01, cat="engine", args={"step": 0})
+    tr.complete("prefill_chunk", 1.01, 1.04, cat="engine", args={"rid": "x"})
+    tr.complete("step", 1.0, 1.05, cat="engine", args={"step": 0})
+    if with_recompile:
+        tr.instant("recompile", cat="perf",
+                   args={"program": "decode", "args": ["tables"],
+                         "changed": {"tables": ["i32[2,4]", "i32[2,5]"]}})
+    for i, (rid, ttft) in enumerate(rids_ttft):
+        _request(tr, rid, 2.0 + i, 0.01 * (i + 1), 0.02, 0.1, ttft)
+    tr.dump(path)
+    return path
+
+
+def test_summary_aggregates_across_files(tmp_path, capsys):
+    f1 = _trace_file(str(tmp_path / "a.json"),
+                     [("req-1", 0.03), ("req-2", 0.07)])
+    f2 = _trace_file(str(tmp_path / "b.json"), [("req-9", 0.5)],
+                     with_recompile=True)
+    s = trace_view.summarize([f1, f2], worst=2)
+    assert s["files"] == 2 and s["requests"] == 3
+    assert s["xla_compiles"] == {"decode": 2}
+    assert len(s["recompiles"]) == 1
+    assert s["recompiles"][0]["program"] == "decode"
+    assert s["recompiles"][0]["args"] == ["tables"]
+    assert s["recompiles"][0]["file"] == "b.json"
+    # engine share: decode_step + prefill_chunk split program time; the
+    # envelope "step" span is excluded from the share base
+    spans = s["engine_spans"]
+    assert spans["step"]["share"] is None
+    # 2 x 0.01s decode_step against 2 x (0.01 + 0.03)s of program time
+    assert spans["decode_step"]["share"] == pytest.approx(0.25, rel=0.05)
+    assert spans["decode_step"]["count"] == 2
+    # worst-N by TTFT, file-attributed, descending
+    worst = s["worst_ttft"]
+    assert [w["rid"] for w in worst] == ["req-9", "req-2"]
+    assert worst[0]["file"] == "b.json"
+    tot = s["request_phase_totals_s"]
+    assert tot["queue"] > 0 and tot["prefill"] > 0 and tot["decode"] > 0
+    # CLI path: table + json forms both exit 0
+    assert trace_view.main(["--summary", f1, f2]) == 0
+    out = capsys.readouterr().out
+    assert "RECOMPILE sentinel events (1)" in out
+    assert "req-9" in out
+    assert trace_view.main(["--summary", "--json", f1, f2]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["files"] == 2
+
+
+def test_summary_reads_flight_dumps_too(tmp_path):
+    tr = Tracer(capacity=64)
+    tr.complete("decode_step", 1.0, 1.2, cat="engine", args={"step": 3})
+    fr = FlightRecorder(str(tmp_path), tr, last_n=16)
+    path = fr.record("watchdog_trip", {"rids": ["req-7"]})
+    assert path is not None
+    s = trace_view.summarize([path])
+    assert s["flight_dumps"][0]["trigger"] == "watchdog_trip"
+    assert s["engine_spans"]["decode_step"]["count"] == 1
+
+
+def test_summary_rejects_malformed_file_naming_it(tmp_path, capsys):
+    good = _trace_file(str(tmp_path / "ok.json"), [("r", 0.1)])
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"traceEvents": [{"name": "", "ph": "i",
+                                                "ts": 1.0}]}))
+    assert trace_view.main(["--summary", good, str(bad)]) == 1
+    assert "name" in capsys.readouterr().err
+
+
+def test_multiple_files_without_summary_is_an_error(tmp_path, capsys):
+    f1 = _trace_file(str(tmp_path / "a.json"), [("r", 0.1)])
+    f2 = _trace_file(str(tmp_path / "b.json"), [("r", 0.1)])
+    assert trace_view.main([f1, f2]) == 1
+    assert "--summary" in capsys.readouterr().err
+
+
+def test_single_file_mode_still_works(tmp_path, capsys):
+    f1 = _trace_file(str(tmp_path / "a.json"), [("req-1", 0.03)])
+    assert trace_view.main([f1]) == 0
+    assert "req-1" in capsys.readouterr().out
+
+
+def test_summary_of_real_engine_trace(tmp_path):
+    """End-to-end: a real serving run's dump must summarize with the two
+    resident programs and no recompile events."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ds.init_inference(model, params=params, dtype="fp32")
+    srv = ServingEngine(eng, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=16, max_model_len=32,
+        prefix_cache=True, prefill_chunk_tokens=8, trace=True))
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        srv.submit(rs.randint(1, 256, 10), max_new_tokens=4)
+    srv.run()
+    path = srv.dump_trace(str(tmp_path / "run.json"))
+    s = trace_view.summarize([path])
+    assert s["xla_compiles"] == {"decode": 1, "chunked_prefill": 1}
+    assert s["recompiles"] == []
+    assert s["requests"] == 3
+    assert "decode_step" in s["engine_spans"]
+    assert "prefill_chunk" in s["engine_spans"]

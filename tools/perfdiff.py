@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Perf-regression gate (``tools/perfdiff.py``): compare two bench
+artifacts with per-metric tolerance bands.
+
+Every ``ds_bench`` artifact now carries a ``meta`` block (git sha,
+jax/jaxlib versions, device kind/count, host — ``monitor/perf.py:
+perf_meta``). This tool is the CI-able bar for perf PRs: it flattens both
+artifacts, classifies every shared numeric metric by DIRECTION
+(lower-is-better latency, higher-is-better throughput, never-increase
+compile/recompile counters), applies a tolerance band, and exits
+non-zero when the candidate regressed — so "it felt fast" stops being an
+acceptable review comment.
+
+Cross-device comparisons are REFUSED (exit 2) unless ``--force``: a
+v5e-vs-CPU diff is not a regression, it is a category error, and an
+artifact with no ``meta`` at all cannot prove it is comparable.
+
+  python tools/perfdiff.py --baseline SERVING_r08.json SERVING_r09.json
+  python tools/perfdiff.py old.json new.json --default-tol 0.3
+  python tools/perfdiff.py old.json new.json --tol ttft_hit_s.p50=0.1
+  python tools/perfdiff.py old.json new.json --force      # cross-device
+
+Exit codes: 0 = no regression, 1 = regression (offenders listed),
+2 = refused / bad input.
+
+Direction rules (matched on the flattened dotted key, first hit wins):
+
+- *never-increase counters* (tolerance 0, any increase is a regression):
+  ``compile_counts.*``, anything containing ``recompile``;
+- *higher-is-better*: speedup / throughput / tokens_per_sec / hit_rate /
+  mfu / mbu / bandwidth / tflops;
+- *lower-is-better*: ttft / latency / wall / overhead / shed_rate /
+  timeout_rate / keys ending in ``_s`` or percentile legs under them;
+- everything else is informational (printed with ``--verbose``, never
+  gates).
+
+The band: lower-is-better regresses when ``cand > base * (1 + tol)``;
+higher-is-better when ``cand < base * (1 - tol)``. A zero baseline
+gates on ``cand > tol`` (the tolerance read as an absolute). The default
+tolerance is deliberately loose (25%) because committed artifacts come
+from shared, noisy CI boxes — tighten per metric with ``--tol`` where a
+bar matters.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: keys that must NEVER increase (tolerance 0): a grown compile count is a
+#: lost invariant, not noise
+NEVER_INCREASE = ("compile_counts.", "recompile")
+
+#: absolute bars, matched on the key's last component: the value itself
+#: must stay under the bar regardless of the baseline (the baseline may
+#: legitimately be negative — tracing overhead measured -2.2% — which a
+#: multiplicative band cannot handle)
+ABS_BARS = {"overhead_pct": 5.0}
+
+HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
+                    "mfu", "mbu", "bandwidth", "gbps", "tflops",
+                    "cached_tokens")
+
+LOWER_IS_BETTER = ("ttft", "latency", "wall", "overhead", "shed_rate",
+                   "timeout_rate", "step_p", "evictions")
+
+#: meta/bookkeeping keys excluded from gating entirely
+SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
+        "prefill_chunk_tokens", "served_tokens", "tokens_generated",
+        "counters.", "by_state.", "offered", "queue_depth_cap", "deadline_s",
+        "perf.peak_", "perf.n_devices", "hbm_")
+
+
+def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a JSON document as {dotted.key: float}."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(flatten(v, f"{prefix}{i}."))
+    elif isinstance(doc, bool):
+        pass
+    elif isinstance(doc, (int, float)):
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def classify(key: str) -> Optional[str]:
+    """"never_increase" | "higher" | "lower" | None (informational)."""
+    low = key.lower()
+    if any(s in low for s in SKIP):
+        return None
+    if low.rsplit(".", 1)[-1] in ABS_BARS:
+        return "abs_bar"
+    if any(s in low for s in NEVER_INCREASE):
+        return "never_increase"
+    if any(s in low for s in HIGHER_IS_BETTER):
+        return "higher"
+    if any(s in low for s in LOWER_IS_BETTER) or low.endswith("_s") \
+            or low.endswith("_s.p50") or low.endswith("_s.p95") \
+            or low.endswith("_s.p99") or low.endswith("_s.max"):
+        return "lower"
+    return None
+
+
+def judge(kind: str, base: float, cand: float, tol: float
+          ) -> Tuple[bool, str]:
+    """(regressed, human delta)."""
+    delta = cand - base
+    pct = f"{100.0 * delta / base:+.1f}%" if base else f"{delta:+g}"
+    if kind == "never_increase":
+        # counters: tol (default 0) read as an ABSOLUTE allowed increase,
+        # so an explicit --tol compile_counts.prefill=2 can admit a
+        # legitimately different bucket mix without loosening the default
+        return (cand > base + tol, pct)
+    if kind == "lower" and base < 0.0:
+        # a negative lower-is-better baseline (e.g. measured-faster
+        # overhead): additive band scaled by the baseline's magnitude
+        return (cand > base + tol * max(abs(base), 1.0), pct)
+    if base == 0.0:
+        # tolerance read as absolute when the baseline carries no scale
+        if kind == "lower":
+            return (cand > tol, pct)
+        return (False, pct)
+    if kind == "lower":
+        return (cand > base * (1.0 + tol), pct)
+    return (cand < base * (1.0 - tol), pct)
+
+
+def check_meta(base: Dict[str, Any], cand: Dict[str, Any], force: bool,
+               base_path: str, cand_path: str) -> Optional[str]:
+    """None when comparable; else the refusal reason (overridable only by
+    --force)."""
+    if force:
+        return None
+    bm, cm = base.get("meta"), cand.get("meta")
+    for name, m in ((base_path, bm), (cand_path, cm)):
+        if not isinstance(m, dict):
+            return (f"{name} carries no 'meta' block — cannot prove the "
+                    f"artifacts are comparable (regenerate it, or pass "
+                    f"--force to compare anyway)")
+    for field in ("device_kind", "platform", "device_count"):
+        if bm.get(field) != cm.get(field):
+            return (f"cross-device comparison refused: {field} differs "
+                    f"({bm.get(field)!r} vs {cm.get(field)!r}); a perf "
+                    f"delta across hardware is a category error, not a "
+                    f"regression (--force to override)")
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two ds_bench artifacts; exit 1 on regression")
+    ap.add_argument("artifacts", nargs="+",
+                    help="BASELINE CANDIDATE (or just CANDIDATE with "
+                         "--baseline)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline artifact path (alternative to the first "
+                         "positional)")
+    ap.add_argument("--default-tol", type=float, default=0.25,
+                    help="tolerance band as a fraction (default 0.25)")
+    ap.add_argument("--tol", action="append", default=[], metavar="KEY=FRAC",
+                    help="per-metric tolerance override (dotted key), "
+                         "repeatable; on never-increase counters the "
+                         "value is an absolute allowed increase")
+    ap.add_argument("--force", action="store_true",
+                    help="compare despite missing meta / differing devices")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print informational (non-gating) metrics")
+    args = ap.parse_args(argv)
+
+    paths = list(args.artifacts)
+    if args.baseline is not None:
+        paths.insert(0, args.baseline)
+    if len(paths) != 2:
+        print("perfdiff: need exactly BASELINE and CANDIDATE "
+              f"(got {len(paths)} paths)", file=sys.stderr)
+        return 2
+    base_path, cand_path = paths
+    try:
+        with open(base_path) as f:
+            base = json.load(f)
+        with open(cand_path) as f:
+            cand = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perfdiff: {e}", file=sys.stderr)
+        return 2
+
+    refusal = check_meta(base, cand, args.force, base_path, cand_path)
+    if refusal:
+        print(f"perfdiff: {refusal}", file=sys.stderr)
+        return 2
+
+    tols: Dict[str, float] = {}
+    for item in args.tol:
+        if "=" not in item:
+            print(f"perfdiff: --tol wants KEY=FRAC, got {item!r}",
+                  file=sys.stderr)
+            return 2
+        k, v = item.split("=", 1)
+        tols[k] = float(v)
+
+    fb, fc = flatten(base), flatten(cand)
+    shared = sorted(set(fb) & set(fc))
+    regressions: List[str] = []
+    rows: List[str] = []
+    for key in shared:
+        kind = classify(key)
+        if kind is None:
+            if args.verbose:
+                rows.append(f"  {'info':<10} {key}: {fb[key]:g} -> "
+                            f"{fc[key]:g}")
+            continue
+        if kind == "abs_bar":
+            bar = ABS_BARS[key.rsplit(".", 1)[-1]]
+            bad = fc[key] > bar
+            rows.append(f"  {'REGRESSED' if bad else 'ok':<10} {key}: "
+                        f"{fb[key]:g} -> {fc[key]:g} (absolute bar "
+                        f"<= {bar:g})")
+            if bad:
+                regressions.append(key)
+            continue
+        tol = tols.get(key, 0.0 if kind == "never_increase"
+                       else args.default_tol)
+        bad, pct = judge(kind, fb[key], fc[key], tol)
+        status = "REGRESSED" if bad else "ok"
+        rows.append(f"  {status:<10} {key}: {fb[key]:g} -> {fc[key]:g} "
+                    f"({pct}, {kind}, tol {tol:g})")
+        if bad:
+            regressions.append(key)
+
+    bm = (base.get("meta") or {})
+    print(f"perfdiff: {base_path} -> {cand_path} "
+          f"[{bm.get('device_kind', 'unknown device')}"
+          f" x{bm.get('device_count', '?')}]: "
+          f"{len(shared)} shared metrics")
+    for r in rows:
+        print(r)
+    only_base = sorted(set(fb) - set(fc))
+    only_cand = sorted(set(fc) - set(fb))
+    if only_base:
+        print(f"  dropped from candidate: {', '.join(only_base[:8])}"
+              + (" ..." if len(only_base) > 8 else ""))
+    if only_cand:
+        print(f"  new in candidate: {', '.join(only_cand[:8])}"
+              + (" ..." if len(only_cand) > 8 else ""))
+    if regressions:
+        print(f"perfdiff: {len(regressions)} regression(s): "
+              f"{', '.join(regressions)}", file=sys.stderr)
+        return 1
+    print("perfdiff: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

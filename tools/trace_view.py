@@ -21,6 +21,16 @@ queue + prefill = TTFT by construction), plus decode time and totals.
   python tools/trace_view.py /tmp/traces/trace_serving_*.json
   python tools/trace_view.py /tmp/traces/flight_watchdog_trip_*.jsonl
   python tools/trace_view.py trace.json --json   # machine-readable
+
+``--summary`` aggregates ACROSS any number of trace/flight files — the
+whole-incident view a directory of dumps wants: per-program engine time
+share (decode vs chunked prefill vs bucketed prefill), per-request phase
+totals, XLA compile counts by kind, every recompile-sentinel event with
+the argument it named, and the worst-N requests by TTFT with the file
+each came from:
+
+  python tools/trace_view.py --summary /tmp/traces/*.json*
+  python tools/trace_view.py --summary --worst 10 --json dir/*.jsonl
 """
 
 import argparse
@@ -129,35 +139,157 @@ def _share(part: float, whole: Optional[float]) -> str:
     return f"{100.0 * part / whole:4.0f}%"
 
 
+def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
+    """Aggregate any number of trace/flight files: engine-span time share,
+    request phase totals, compile counts, recompile-sentinel events, and
+    the worst-``worst`` requests by TTFT. Raises ValueError naming the
+    offending file on malformed input."""
+    total_events = 0
+    flights: List[Dict[str, Any]] = []
+    engine_spans: Dict[str, List[float]] = {}   # name -> [count, total_us]
+    compiles: Dict[str, int] = {}
+    recompiles: List[Dict[str, Any]] = []
+    phase_totals = {p: 0.0 for p in PHASES}
+    requests: List[Dict[str, Any]] = []
+    for path in paths:
+        events, header = load_events(path)  # ValueError on bad structure
+        problem = validate(events)
+        if problem is not None:
+            raise ValueError(f"schema violation at {problem}")
+        total_events += len(events)
+        if header is not None:
+            flights.append({"file": os.path.basename(path),
+                            "trigger": header.get("trigger"),
+                            "detail": header.get("detail", {})})
+        for ev in events:
+            name = ev.get("name", "")
+            if ev.get("ph") == "X" and ev.get("cat") in ("engine", "train"):
+                c = engine_spans.setdefault(name, [0, 0.0])
+                c[0] += 1
+                c[1] += ev.get("dur", 0.0)
+            elif name == "xla_compile":
+                kind = (ev.get("args") or {}).get("kind", "?")
+                compiles[kind] = compiles.get(kind, 0) + 1
+            elif name == "recompile":
+                recompiles.append({"file": os.path.basename(path),
+                                   **(ev.get("args") or {})})
+        for rid, rec in request_breakdown(events).items():
+            requests.append({"rid": rid, "file": os.path.basename(path),
+                             **rec})
+            for p in PHASES:
+                phase_totals[p] += rec[f"{p}_s"]
+    # the engine-program share excludes envelope spans ("step" wraps the
+    # whole mixed step; "train_batch" wraps train_step + data_fetch)
+    envelopes = {"step", "train_batch"}
+    prog_us = {n: c for n, c in engine_spans.items() if n not in envelopes}
+    share_base = sum(c[1] for c in prog_us.values())
+    worst_reqs = sorted((r for r in requests if r.get("ttft_s") is not None),
+                        key=lambda r: -r["ttft_s"])[:worst]
+    return {
+        "files": len(paths),
+        "events": total_events,
+        "flight_dumps": flights,
+        "engine_spans": {
+            n: {"count": int(c[0]), "total_s": c[1] / 1e6,
+                "share": (c[1] / share_base) if share_base and
+                         n not in envelopes else None}
+            for n, c in sorted(engine_spans.items())},
+        "xla_compiles": compiles,
+        "recompiles": recompiles,
+        "requests": len(requests),
+        "request_phase_totals_s": phase_totals,
+        "worst_ttft": worst_reqs,
+    }
+
+
+def _print_summary(s: Dict[str, Any]) -> None:
+    print(f"{s['files']} file(s), {s['events']} events, "
+          f"{s['requests']} request timelines, schema OK")
+    for fl in s["flight_dumps"]:
+        print(f"  flight dump: {fl['file']} trigger={fl['trigger']!r} "
+              f"{json.dumps(fl['detail'])}")
+    if s["engine_spans"]:
+        print("engine/train span time (share of program time):")
+        for n, rec in s["engine_spans"].items():
+            share = "  env" if rec["share"] is None \
+                else f"{100.0 * rec['share']:4.0f}%"
+            print(f"  {n:<18}{rec['count']:>7} x  {rec['total_s']:9.4f}s"
+                  f"  {share}")
+    if s["xla_compiles"]:
+        print("xla compiles: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s["xla_compiles"].items())))
+    if s["recompiles"]:
+        print(f"RECOMPILE sentinel events ({len(s['recompiles'])}):")
+        for r in s["recompiles"]:
+            print(f"  {r.get('file')}: program={r.get('program')} "
+                  f"args={r.get('args')} changed={json.dumps(r.get('changed', {}))}")
+    else:
+        print("recompile sentinel events: none")
+    pt = s["request_phase_totals_s"]
+    whole = sum(pt.values())
+    print("request phase totals: " + ", ".join(
+        f"{p}={pt[p]:.4f}s ({_share(pt[p], whole).strip()})"
+        for p in PHASES))
+    if s["worst_ttft"]:
+        print(f"worst {len(s['worst_ttft'])} requests by TTFT:")
+        for r in s["worst_ttft"]:
+            print(f"  {r['rid']:<12}{r['ttft_s']:9.4f}s  queue "
+                  f"{_share(r['queue_s'], r['ttft_s']).strip()}, prefill "
+                  f"{_share(r['prefill_s'], r['ttft_s']).strip()}  "
+                  f"[{r['file']}]")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="validate a trace / "
                                  "flight-recorder file and print the "
                                  "per-request TTFT phase breakdown")
-    ap.add_argument("path", help="Chrome-trace JSON or flight-recorder "
-                                 "JSONL")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="Chrome-trace JSON or flight-recorder JSONL "
+                         "(several with --summary)")
+    ap.add_argument("--summary", action="store_true",
+                    help="aggregate across ALL given files: engine time "
+                         "share, recompile events, worst-N TTFT")
+    ap.add_argument("--worst", type=int, default=5,
+                    help="requests in the worst-TTFT list (--summary)")
     ap.add_argument("--json", action="store_true",
                     help="emit the breakdown as JSON instead of a table")
     args = ap.parse_args(argv)
 
+    if args.summary:
+        try:
+            s = summarize(args.paths, worst=args.worst)
+        except (OSError, ValueError) as e:
+            print(f"trace_view: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(s, indent=2))
+        else:
+            _print_summary(s)
+        return 0
+    if len(args.paths) != 1:
+        print("trace_view: multiple files need --summary (per-file "
+              "breakdown is one file at a time)", file=sys.stderr)
+        return 1
+    path = args.paths[0]
     try:
-        events, header = load_events(args.path)
+        events, header = load_events(path)
     except (OSError, ValueError) as e:
-        print(f"trace_view: {args.path}: {e}", file=sys.stderr)
+        print(f"trace_view: {path}: {e}", file=sys.stderr)
         return 1
     problem = validate(events)
     if problem is not None:
-        print(f"trace_view: {args.path}: schema violation at {problem}",
+        print(f"trace_view: {path}: schema violation at {problem}",
               file=sys.stderr)
         return 1
 
     reqs = request_breakdown(events)
     if args.json:
-        print(json.dumps({"path": args.path, "events": len(events),
+        print(json.dumps({"path": path, "events": len(events),
                           "flight_header": header, "requests": reqs},
                          indent=2))
         return 0
 
-    print(f"{args.path}: {len(events)} events, schema OK")
+    print(f"{path}: {len(events)} events, schema OK")
     if header is not None:
         print(f"flight recorder: trigger={header.get('trigger')!r} "
               f"detail={json.dumps(header.get('detail', {}))} "
