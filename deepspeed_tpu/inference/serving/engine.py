@@ -3,29 +3,44 @@
 The batch-offline ``InferenceEngine.generate`` compiles one program per
 ``(batch, prompt_len, max_new_tokens)`` shape and runs every sequence
 lock-step to the longest; this engine instead keeps ONE resident compiled
-decode step whose shapes never change — ``max_batch_size`` slots over a
-shared page pool — and serves arbitrary request mixes by changing only the
-DATA it feeds that step (block tables, context lengths, last tokens). The
-design follows "Ragged Paged Attention" (arxiv 2604.15464): ragged-ness
-lives in indices, not shapes, so heavy mixed traffic never recompiles.
+MIXED step whose shapes never change and serves arbitrary request mixes by
+changing only the DATA it feeds that step. The design follows "Ragged
+Paged Attention" (arxiv 2604.15464) end to end: the step's token axis is a
+flat PACKED batch — one decode token per running resident plus this step's
+budgeted prefill chunks, laid out as contiguous per-slot segments — and
+raggedness (segment offsets/lengths, chunk starts, context lengths, block
+tables) rides scalar descriptors, never the compiled shape. Decode rows
+and prefill chunks run on the SAME attention grid
+(``ops/pallas/ragged_attention.py``), so there is no sentinel-row waste
+for mid-prefill slots, no second resident compile, and no prefill/decode
+scheduling seam: heavy mixed traffic is one device dispatch per step and
+never recompiles.
 
-Per :meth:`ServingEngine.step`:
+Per :meth:`ServingEngine.step` (the default unified path):
 
-1. **admit** — FIFO queue head(s) get a slot + pages; their prompt runs
-   through a bucketed prefill program (one compile per power-of-two prompt
-   bucket) which appends prompt KV into their pages and samples the first
-   token (TTFT ends here);
-2. **grow/preempt** — every running sequence is guaranteed a page for the
-   token this step appends; when the pool is dry the most-recently-admitted
-   sequence is evicted back to the queue front (recompute-style);
-3. **decode** — the single jitted ragged step appends each slot's last
-   token, runs block-table attention over every layer, and samples the next
-   token for all slots at once; finished sequences (EOS / budget) release
-   slot + pages the same step.
+1. **admit** — FIFO queue head(s) get a slot + pages (prefix-cache hits
+   acquire cached pages); their prompt starts consuming the step's prefill
+   token budget as packed chunk segments;
+2. **grow/preempt** — every decoding sequence is guaranteed a page for the
+   token this step appends; when the pool is dry the lowest-priority
+   most-recently-admitted sequence is evicted back to the queue front
+   (recompute-style);
+3. **mixed step** — the single jitted program appends every packed token's
+   KV through its row's block table, attends decode rows (1 query at
+   ``context - 1``) and chunk rows (n queries from ``chunk_start``) on one
+   ragged grid, and samples each row's last-position token; decode rows
+   harvest it, a final chunk harvests token one (TTFT ends there), and
+   finished sequences release slot + pages the same step.
+
+``ServingConfig.mixed_step=False`` keeps the PREVIOUS two-program engine
+(ragged decode over ``max_batch_size`` slots + a ``[1, chunk]`` chunked
+prefill, with bucketed monolithic prefill when chunking is off) — kept so
+benchmarks and parity tests can A/B the unified step against it in the
+same run; new deployments should not use it.
 
 Compile counts are instrumented (the trace-time counter in
 ``compile_counts``) so tests can assert the whole mixed-traffic run used
-exactly one compiled decode step.
+exactly ONE compiled serving step (``{"mixed_step": 1}``).
 
 Overload control and fault recovery (the resilience contract):
 
@@ -77,7 +92,7 @@ from .scheduler import RejectedError, Request, RequestState, Scheduler
 
 
 class StepWatchdogTimeout(RuntimeError):
-    """The resident decode step exceeded ``step_watchdog_s`` wall-clock."""
+    """A resident serving step exceeded ``step_watchdog_s`` wall-clock."""
 
 
 @dataclasses.dataclass
@@ -94,6 +109,13 @@ class ServingConfig:
     #: per-sequence cap on prompt + generated tokens; also fixes the block
     #: table width (ceil(max_model_len / block_size))
     max_model_len: int = 512
+    #: ONE resident serving program (the default): decode rows and prefill
+    #: chunks packed into a single ragged token batch per step — no
+    #: sentinel decode rows, no second resident compile, one device
+    #: dispatch per step. False = the LEGACY two-program engine (resident
+    #: decode + chunked prefill / bucketed monolithic prefill), kept only
+    #: so benches and parity tests can A/B against it in the same run.
+    mixed_step: bool = True
     # sampling (static per engine: they shape the compiled programs)
     do_sample: bool = False
     temperature: float = 1.0
@@ -101,8 +123,9 @@ class ServingConfig:
     top_p: float = 1.0
     seed: int = 0
     #: smallest prefill bucket (prompt lengths pad up to powers of two from
-    #: here; each bucket compiles once). Only the LEGACY monolithic prefill
-    #: uses buckets; chunked prefill has one fixed-shape program.
+    #: here; each bucket compiles once). Only the LEGACY
+    #: (``mixed_step=False``, chunking off) monolithic prefill uses
+    #: buckets; the unified step needs no prefill program at all.
     prefill_bucket_min: int = 8
     # -- prefix caching + chunked prefill ------------------------------
     #: content-addressed KV reuse: full pages are indexed by a hash chained
@@ -112,17 +135,18 @@ class ServingConfig:
     #: evicted LRU instead of blanked. Implies chunked prefill (the
     #: from-empty monolithic prefill cannot attend a cached prefix).
     prefix_cache: bool = False
-    #: chunked prefill: compiled chunk length in tokens (0 = legacy
-    #: monolithic bucketed prefill). ONE resident program serves every
-    #: chunk — offsets, block tables and cached-prefix lengths ride as
-    #: data — so long prompts stop monopolizing the step loop.
-    #: With prefix_cache on and this 0, the engine derives 4 * block_size
-    #: (the config object itself is never mutated).
+    #: prefill chunk length in tokens — with ``mixed_step`` the per-row
+    #: per-round granularity of budget packing (fairness knob; a row may
+    #: accumulate several rounds); legacy: the compiled ``[1, chunk]``
+    #: chunked-prefill shape (0 there = monolithic bucketed prefill).
+    #: 0 derives 4 * block_size on the unified path (legacy derives it
+    #: only with prefix_cache on); the config object is never mutated.
     prefill_chunk_tokens: int = 0
-    #: per-step prefill token budget of the MIXED step: at most this many
+    #: per-step prefill token budget of the mixed step: at most this many
     #: prompt tokens run per step, so resident decoders keep stepping
-    #: every iteration (no prefill head-of-line blocking). 0 = one chunk's
-    #: worth per step.
+    #: every iteration (no prefill head-of-line blocking). With
+    #: ``mixed_step`` it also sizes the packed token batch
+    #: (``max_batch_size - 1 + budget``). 0 = one chunk's worth per step.
     prefill_token_budget: int = 0
     #: write serving counters to the monitor every N steps (0 = never)
     monitor_every: int = 1
@@ -205,15 +229,21 @@ class ServingEngine:
             raise ValueError(
                 "prefill_chunk_tokens and prefill_token_budget must be "
                 ">= 0 (0 = default)")
-        # chunk length of the resident chunked-prefill program (0 = legacy
-        # monolithic bucketed prefill) and the mixed step's per-step
-        # prefill token budget — derived, never written back into the
-        # caller's (possibly shared) config object
+        # chunk length (unified: the budget-packing granularity; legacy:
+        # the resident chunked-prefill shape, 0 = monolithic bucketed
+        # prefill) and the per-step prefill token budget — derived, never
+        # written back into the caller's (possibly shared) config object
+        self._mixed = bool(cfg.mixed_step)
         chunk = cfg.prefill_chunk_tokens
-        if cfg.prefix_cache and chunk <= 0:
+        if chunk <= 0 and (self._mixed or cfg.prefix_cache):
             chunk = 4 * cfg.block_size
         self._chunk = min(chunk, cfg.max_model_len) if chunk > 0 else 0
         self._chunk_budget = cfg.prefill_token_budget or self._chunk
+        # packed token capacity of the unified step: every slot may decode
+        # (1 token each) OR — when at least one slot is mid-prefill — up
+        # to max_batch_size - 1 decoders plus the whole prefill budget
+        self._mixed_tokens = max(cfg.max_batch_size,
+                                 cfg.max_batch_size - 1 + self._chunk_budget)
 
         # tracing first: scheduler and pool take the tracer at construction
         # (NULL-like when disabled — emission sites cost one bool check)
@@ -268,16 +298,20 @@ class ServingEngine:
         #: manual brownout override: None = automatic (occupancy), else forced
         self._brownout_forced: Optional[bool] = None
         #: trace-time counters — a retrace IS a recompile, so these count
-        #: XLA compiles of each program kind
-        self.compile_counts = {"decode": 0, "prefill": 0,
-                               "chunked_prefill": 0}
-        #: first decode / chunked-prefill call carries the XLA compile and
-        #: is never watchdog-judged (heartbeat.py's first-beat rule)
+        #: XLA compiles of each program kind. The unified engine has ONE
+        #: resident program; the legacy keys exist only in legacy mode (a
+        #: retired ``chunked_prefill`` entry must read as gone, not as 0)
+        self.compile_counts = {"mixed_step": 0} if self._mixed else \
+            {"decode": 0, "prefill": 0, "chunked_prefill": 0}
+        #: first mixed/decode/chunked-prefill call carries the XLA compile
+        #: and is never watchdog-judged (heartbeat.py's first-beat rule)
+        self._mixed_warm = False
         self._decode_warm = False
         self._chunked_warm = False
         #: the one abandoned watchdog thread, if still wedged in device
         #: compute — bounds thread growth to 1 under a persistent hang
         self._wedged: Optional[threading.Thread] = None
+        self._mixed_fn = None
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
         self._chunked_prefill_fn = None
@@ -574,11 +608,19 @@ class ServingEngine:
 
     @property
     def prefill_chunk_tokens(self) -> int:
-        """EFFECTIVE chunk length of the resident chunked-prefill program
-        (0 = legacy monolithic prefill). May differ from the config field:
-        with ``prefix_cache`` on and the field 0, the engine derives
-        ``4 * block_size`` without mutating the caller's config."""
+        """EFFECTIVE prefill chunk length (unified: the budget-packing
+        granularity; legacy: the resident chunked-prefill shape, 0 =
+        monolithic prefill). May differ from the config field: when the
+        field is 0 the engine derives ``4 * block_size`` (unified always,
+        legacy only with ``prefix_cache``) without mutating the caller's
+        config."""
         return self._chunk
+
+    @property
+    def mixed_step_tokens(self) -> int:
+        """Packed token capacity of the ONE resident mixed step (0 on the
+        legacy two-program engine)."""
+        return self._mixed_tokens if self._mixed else 0
 
     # ------------------------------------------------------------------
     # one scheduler step
@@ -647,6 +689,13 @@ class ServingEngine:
                 self.metrics.prefix_hits += 1
                 self.metrics.cached_prefill_tokens += req.prefix_len
                 self.metrics.prefill_tokens += req.prefix_len
+            if self._mixed:
+                # unified path: the request's table row is live from
+                # admission (no sentinel rows — its packed segments carry
+                # their own query_len, so an un-granted row is inert) and
+                # its prompt starts consuming the packed step's budget
+                self._write_table_row(req)
+                continue
             if self._chunk:
                 continue  # prefill runs below, under the step token budget
             try:
@@ -657,7 +706,12 @@ class ServingEngine:
                 self._fail_prefill(req, e)
         self._account_reaped()
 
-        # 2b. the prefill half of the MIXED step: at most
+        if self._mixed:
+            # the whole device half of the step is ONE packed dispatch
+            self._step_mixed(t0, brownout)
+            return
+
+        # 2b. the prefill half of the LEGACY step: at most
         # ``prefill_token_budget`` prompt tokens run through the resident
         # chunked-prefill program, round-robin across prefilling residents,
         # so the decode below still fires every iteration — a long prompt
@@ -668,28 +722,7 @@ class ServingEngine:
         # 3. page growth for this step's appends, preempting when dry
         # (mid-prefill residents own every prompt page already and do not
         # decode this step — nothing to grow)
-        for _, req in list(self.sched.active()):
-            if req.state is not RequestState.RUNNING or req.prefilling:
-                continue  # preempted below while growing an earlier slot
-            while not self.sched.ensure_decode_headroom(req):
-                victim = self.sched.preempt_victim(exclude=req)
-                if victim is None:
-                    # nobody left to evict: the pool cannot hold even one
-                    # sequence at this length — a sizing error, not traffic
-                    slot = req.slot
-                    self.sched.fail(req, "kv_pool_exhausted")
-                    self._clear_slot_arrays(slot)
-                    self.metrics.requests_failed += 1
-                    break
-                self._preempt(victim)
-            else:
-                # this step appends at seq_len: never into a page other
-                # sequences still reference — copy-on-write first
-                self._ensure_exclusive(req, req.seq_len // self.block_pool.
-                                       block_size)
-                self._write_table_row(req)  # growth may have added a page
-                continue
-            break
+        self._grow_decode_pages()
 
         # 4. the single ragged decode step over all slots, watchdog-bounded
         active = [(s, r) for s, r in self.sched.active()
@@ -783,16 +816,7 @@ class ServingEngine:
                 bad = np.asarray(bad)
                 for slot, req in active:
                     if self.config.logit_guard and bad[slot]:
-                        if tr.enabled:
-                            tr.instant("quarantine", cat="engine",
-                                       args={"rid": req.rid, "slot": slot,
-                                             "step": step_no})
-                        self.sched.fail(req, "corrupt_logits")
-                        self._clear_slot_arrays(slot)
-                        self.metrics.logit_quarantines += 1
-                        self.metrics.requests_failed += 1
-                        self._flight("logit_quarantine", rid=req.rid,
-                                     slot=slot, step=step_no)
+                        self._quarantine(slot, req, step_no, where="decode")
                         continue
                     req.seq_len += 1
                     self._seq_lens[slot] = req.seq_len
@@ -821,8 +845,8 @@ class ServingEngine:
         m.blocks_cached = self.block_pool.cached_count
         m.prefix_evictions = self.block_pool.evictions
         prefilling = [r for _, r in self.sched.active() if r.prefilling]
-        m.chunked_prefill_waiting = len(prefilling)
-        m.chunked_prefill_queue_age_s = 0.0 if not prefilling else \
+        m.prefill_waiting = len(prefilling)
+        m.prefill_queue_age_s = 0.0 if not prefilling else \
             time.perf_counter() - min(r.submit_time for r in prefilling)
         m.brownout_active = brownout
         m.recompiles = self.perf.recompile_total
@@ -832,6 +856,317 @@ class ServingEngine:
         if self.monitor is not None and self.config.monitor_every and \
                 self._step_no % self.config.monitor_every == 0:
             self.monitor.write_events(m.to_events(self._step_no))
+
+    # ------------------------------------------------------------------
+    # the unified mixed step (ONE resident program per step)
+    # ------------------------------------------------------------------
+
+    def _grow_decode_pages(self) -> None:
+        """Guarantee every decoding resident a page for the token this
+        step appends, preempting (lowest priority, newest first) when the
+        pool runs dry; shared append targets are copied-on-write."""
+        for _, req in list(self.sched.active()):
+            if req.state is not RequestState.RUNNING or req.prefilling:
+                continue  # preempted below while growing an earlier slot
+            while not self.sched.ensure_decode_headroom(req):
+                victim = self.sched.preempt_victim(exclude=req)
+                if victim is None:
+                    # nobody left to evict: the pool cannot hold even one
+                    # sequence at this length — a sizing error, not traffic
+                    slot = req.slot
+                    self.sched.fail(req, "kv_pool_exhausted")
+                    self._clear_slot_arrays(slot)
+                    self.metrics.requests_failed += 1
+                    break
+                self._preempt(victim)
+            else:
+                # this step appends at seq_len: never into a page other
+                # sequences still reference — copy-on-write first
+                self._ensure_exclusive(req, req.seq_len // self.block_pool.
+                                       block_size)
+                self._write_table_row(req)  # growth may have added a page
+                continue
+            break
+
+    def _step_mixed(self, t0: float, brownout: bool) -> None:
+        """The device half of the unified step: pack one decode token per
+        running resident plus this step's budgeted prefill chunks into a
+        single ragged token batch, dispatch the ONE resident program, and
+        harvest per row. Raggedness — segment offsets/lengths, chunk
+        starts, context lengths, block tables — rides as DATA, so any
+        traffic mix reuses one compile and one dispatch."""
+        cfg = self.config
+        self._grow_decode_pages()
+
+        # prefill grants: round-robin chunk-sized shares of the step's
+        # token budget across mid-prefill residents (admission order);
+        # grants to one request are contiguous, so several rounds simply
+        # extend its packed segment
+        grants = self.sched.plan_prefill_grants(self._chunk_budget,
+                                                self._chunk)
+        for _, req in list(self.sched.active()):
+            if not req.prefilling or req.rid not in grants:
+                continue
+            try:
+                # chaos point: DS_FAULT=flaky_prefill fails ITS request
+                # host-side, before it is packed — everyone else still
+                # rides this step
+                fault_injection.maybe_fail("flaky_prefill",
+                                           exc=RuntimeError,
+                                           tag="serving_prefill",
+                                           step=self._step_no)
+            except Exception as e:
+                grants.pop(req.rid, None)
+                self._fail_prefill(req, e)
+                continue
+            # COW any chunk-spanned page another sequence still references
+            # (appends into shared pages must be impossible by
+            # construction, not by luck)
+            start, n = req.prefill_done, grants[req.rid]
+            bs = self.block_pool.block_size
+            for idx in range(start // bs, (start + n - 1) // bs + 1):
+                self._ensure_exclusive(req, idx)
+            self._write_table_row(req)
+
+        # pack segments slot-ascending (the ragged kernel's contract) —
+        # decode rows are 1 token, granted prefill rows up to their grant,
+        # everything else (empty slots, un-granted prefillers) is inert
+        R, T = cfg.max_batch_size, self._mixed_tokens
+        ids = np.zeros((1, T), np.int32)
+        pos = np.full((1, T), -1, np.int32)
+        trow = np.full((1, T), -1, np.int32)
+        row_start = np.zeros((R,), np.int32)
+        row_len = np.zeros((R,), np.int32)
+        row_cs = np.zeros((R,), np.int32)
+        row_cl = np.zeros((R,), np.int32)
+        decodes, prefills = [], []
+        cursor = 0
+        for slot, req in self.sched.active():
+            if req.state is not RequestState.RUNNING:
+                continue
+            if req.prefilling:
+                n = grants.get(req.rid, 0)
+                if not n:
+                    continue
+                start = req.prefill_done
+                ids[0, cursor:cursor + n] = \
+                    req.resume_tokens[start:start + n]
+                pos[0, cursor:cursor + n] = np.arange(start, start + n)
+                trow[0, cursor:cursor + n] = slot
+                row_start[slot], row_len[slot] = cursor, n
+                row_cs[slot], row_cl[slot] = start, start + n
+                prefills.append((slot, req, n,
+                                 start + n >= req.prefill_target))
+                cursor += n
+            else:
+                ids[0, cursor] = self._last_tok[slot]
+                pos[0, cursor] = req.seq_len
+                trow[0, cursor] = slot
+                row_start[slot], row_len[slot] = cursor, 1
+                row_cs[slot], row_cl[slot] = req.seq_len, req.seq_len + 1
+                decodes.append((slot, req))
+                cursor += 1
+        assert cursor <= T, f"packed {cursor} tokens into a {T}-token step"
+        if cursor == 0:
+            self._finish_step_bookkeeping(t0, brownout)
+            return
+
+        # corrupt_logits chaos, both tags, as DATA (no recompile): the
+        # serving_step vocabulary pins a decode slot (slot=N, falling back
+        # to the first decode row on a bad/absent pin), serving_prefill
+        # flags the first packed chunk. Each tag is probed only when a
+        # matching row is packed — a bounded (fails=N) spec must spend its
+        # budget on a step it can actually poison
+        corrupt = np.zeros((R,), bool)
+        if decodes:
+            spec = fault_injection.maybe_flag("corrupt_logits",
+                                              tag="serving_step",
+                                              step=self._step_no)
+            if spec is not None:
+                decode_slots = {s for s, _ in decodes}
+                try:
+                    pin = int(spec.params["slot"])
+                except (KeyError, ValueError):
+                    pin = decodes[0][0]
+                if pin not in decode_slots:
+                    pin = decodes[0][0]
+                corrupt[pin] = True
+        if prefills and fault_injection.maybe_flag(
+                "corrupt_logits", tag="serving_prefill",
+                step=self._step_no) is not None:
+            corrupt[prefills[0][0]] = True
+
+        self._rng, rng = jax.random.split(self._rng)
+        step_no = self._step_no
+        # snapshot everything the guarded thread touches on THIS thread
+        # (the watchdog-abandonment rule of the legacy decode step)
+        call_args = (self.engine.params, self.pool,
+                     jnp.asarray(self._tables),
+                     jnp.asarray(ids), jnp.asarray(trow), jnp.asarray(pos),
+                     jnp.asarray(row_start), jnp.asarray(row_len),
+                     jnp.asarray(row_cs), jnp.asarray(row_cl),
+                     jnp.asarray(corrupt), rng)
+
+        has_prefill = bool(prefills)
+
+        def device_step():
+            # chaos points INSIDE the guarded region: the decode and
+            # prefill stall vocabularies both land on the one dispatch
+            # now. slow_chunk is probed only when prefill rows are packed
+            # — a bounded spec must spend its budget on a step that
+            # exercises prefill work (same rule as the corrupt probes)
+            fault_injection.maybe_stall("slow_step", tag="serving_step",
+                                        step=step_no)
+            if has_prefill:
+                fault_injection.maybe_stall("slow_chunk",
+                                            tag="serving_prefill",
+                                            step=step_no)
+            return self._mixed_dispatch(call_args)
+
+        tr = self.tracer
+        t_dev = time.perf_counter()
+        was_warm = self._mixed_warm
+        try:
+            # first-beat rule: the compile-carrying first call is never
+            # watchdog-judged; steady-state wedges always are
+            if was_warm:
+                toks, bad, self.pool = self._guarded(device_step)
+            else:
+                toks, bad, self.pool = device_step()
+                self._mixed_warm = True
+        except StepWatchdogTimeout as e:
+            log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
+            self.metrics.watchdog_trips += 1
+            packed = [(s, r) for s, r in decodes] + \
+                     [(s, r) for s, r, _, _ in prefills]
+            rids = [r.rid for _, r in packed]
+            if tr.enabled:
+                tr.instant("watchdog_trip", cat="engine",
+                           args={"step": step_no, "rids": rids})
+            for slot, req in packed:
+                self.sched.fail(req, "step_watchdog")
+                self._clear_slot_arrays(slot)
+                self.metrics.requests_failed += 1
+            self._flight("watchdog_trip", step=step_no, rids=rids,
+                         budget_s=cfg.step_watchdog_s)
+        else:
+            t_end = time.perf_counter()
+            n_prefill = cursor - len(decodes)
+            if tr.enabled:
+                # the one engine span of the unified step, carrying the
+                # per-row decode/prefill token split (what decode_step +
+                # chunked_prefill used to say in two spans)
+                tr.complete("mixed_step", t_dev, t_end, cat="engine",
+                            args={"step": step_no,
+                                  "decode_tokens": len(decodes),
+                                  "prefill_tokens": n_prefill,
+                                  "rows": len(decodes) + len(prefills)})
+            if was_warm:
+                # first-beat rule for gauges too (compile wall time would
+                # report garbage utilization)
+                self._note_mixed_perf(t_end - t_dev, tokens=cursor)
+            toks = np.asarray(toks)
+            bad = np.asarray(bad)
+            for slot, req, n, final in prefills:
+                start = req.prefill_done
+                req.prefill_done = start + n
+                req.seq_len = start + n
+                self.metrics.prefill_tokens += n
+                self.metrics.prefill_tokens_computed += n
+                self.metrics.window_tokens += n
+                # guard EVERY chunk and BEFORE content-indexing: poisoned
+                # KV must never park on the prefix-cache LRU
+                if cfg.logit_guard and bad[slot]:
+                    self._quarantine(slot, req, step_no, where="prefill")
+                    continue
+                self._commit_full_blocks(req)
+                if final:
+                    # last chunk: token one (TTFT ends here); the slot
+                    # decodes from the NEXT step on
+                    self._seq_lens[slot] = req.seq_len
+                    self._harvest(req, int(toks[slot]))
+            for slot, req in decodes:
+                if cfg.logit_guard and bad[slot]:
+                    self._quarantine(slot, req, step_no, where="decode")
+                    continue
+                req.seq_len += 1
+                self._seq_lens[slot] = req.seq_len
+                # a generated token may have just FILLED a page —
+                # content-index it so identical continuations hit
+                self._commit_full_blocks(req)
+                self._harvest(req, int(toks[slot]))
+
+        self._finish_step_bookkeeping(t0, brownout)
+
+    def _mixed_dispatch(self, call_args):
+        """The ONE observed entry to the resident mixed program. Every
+        dispatch is fingerprint-observed first (shapes/dtypes/statics): a
+        fingerprint change IS a recompile, so the sentinel fires a
+        `recompile` tracer event + registry counter naming the offending
+        argument before the stall even happens. The first call also
+        captures the program's cost model for MFU/MBU."""
+        if self._mixed_fn is None:
+            self._mixed_fn = self._build_mixed_step()
+        (params, pool, tables, ids, token_rows, append_pos, row_start,
+         row_len, chunk_start, context_len, corrupt, rng) = call_args
+        self.perf.observe_call(
+            "mixed_step",
+            params=self.perf.cached_spec("params", params),
+            pool=pool, tables=tables, ids=ids, token_rows=token_rows,
+            append_pos=append_pos, row_start=row_start, row_len=row_len,
+            chunk_start=chunk_start, context_len=context_len,
+            corrupt=corrupt, rng=rng)
+        out = self._mixed_fn(*call_args)
+        if self.perf.programs.program("mixed_step").cost_pending:
+            # first call (watchdog-exempt): lowering is cached by jax, so
+            # this pays no second trace and no XLA compile
+            self.perf.capture_cost("mixed_step", self._mixed_fn, call_args,
+                                   fallback=self._mixed_cost_estimate)
+        return out
+
+    def _quarantine(self, slot: int, req: Request, step_no: int,
+                    where: str) -> None:
+        """NaN/Inf logits on one packed row: quarantine THAT request
+        (terminal FAILED, pages returned, flight dump), never the batch."""
+        if self.tracer.enabled:
+            self.tracer.instant("quarantine", cat="engine",
+                                args={"rid": req.rid, "slot": slot,
+                                      "step": step_no, "where": where})
+        self.sched.fail(req, "corrupt_logits")
+        self._clear_slot_arrays(slot)
+        self.metrics.logit_quarantines += 1
+        self.metrics.requests_failed += 1
+        self._flight("logit_quarantine", rid=req.rid, slot=slot,
+                     step=step_no, where=where)
+
+    def _note_mixed_perf(self, dt_s: float, tokens: int) -> None:
+        """Per-step utilization of the unified program (serving snapshot +
+        flight dumps): MBU stays the honest gauge — the step is still
+        dominated by the param + KV read."""
+        vals = self.perf.on_program_step("mixed_step", dt_s, tokens=tokens)
+        m = self.metrics
+        m.mixed_flops_per_step = vals["flops_per_step"]
+        m.mixed_bytes_per_step = vals["bytes_per_step"]
+        m.mixed_mfu = vals["mfu"]
+        m.mixed_mbu = vals["mbu"]
+        m.mixed_tokens_per_sec_per_chip = vals["tokens_per_sec_per_chip"]
+
+    def _mixed_cost_estimate(self):
+        """Hand-rolled mixed-step cost where the backend has no cost
+        model: the packed batch computes every padded token position and
+        reads params once + every row's table-width KV walk — exactly the
+        compiled program's work."""
+        mcfg = getattr(self.engine.module, "config", None)
+        if mcfg is None:
+            return None
+        B, ctx = self.config.max_batch_size, self.config.max_model_len
+        return {
+            "flops": self._mixed_tokens * transformer_flops_per_token(
+                mcfg, ctx),
+            "bytes_accessed": estimate_decode_step_bytes(
+                mcfg, B, ctx, param_bytes(self.engine.params),
+                kv_bytes_per_elem=self._kv_bytes_per_elem),
+        }
 
     # ------------------------------------------------------------------
     # defrag
@@ -858,10 +1193,13 @@ class ServingEngine:
             self.pool = self._defrag_fn(self.pool, jnp.asarray(src, jnp.int32))
         for _, req in self.sched.active():
             req.blocks = [mapping[b] for b in req.blocks]
-            if not req.prefilling:
-                # mid-prefill residents keep a SENTINEL decode row until
-                # their last chunk lands (writing it early would let the
-                # decode step append garbage into their pages)
+            if self._mixed or not req.prefilling:
+                # unified path: every resident's table row is live (its
+                # packed segments carry their own lengths, so nothing can
+                # append where it should not). LEGACY: mid-prefill
+                # residents keep a SENTINEL decode row until their last
+                # chunk lands (writing it early would let the decode step
+                # append garbage into their pages)
                 self._write_table_row(req)
         return moved
 
@@ -907,9 +1245,9 @@ class ServingEngine:
             raise box["err"]
         if "out" in box:
             return box["out"]
-        self._wedged = t  # step() skips decode while this is still alive
+        self._wedged = t  # step() skips the device while this is alive
         raise StepWatchdogTimeout(
-            f"decode step exceeded {timeout:.3f}s wall-clock "
+            f"resident serving step exceeded {timeout:.3f}s wall-clock "
             f"(step {self._step_no})")
 
     # -- performance accounting ----------------------------------------
@@ -1046,16 +1384,7 @@ class ServingEngine:
         self.metrics.prefill_tokens_computed += L
         self.metrics.window_tokens += L
         if self.config.logit_guard and bool(np.asarray(bad)[0]):
-            slot = req.slot
-            if tr.enabled:
-                tr.instant("quarantine", cat="engine",
-                           args={"rid": req.rid, "where": "prefill"})
-            self.sched.fail(req, "corrupt_logits")
-            self._clear_slot_arrays(slot)
-            self.metrics.logit_quarantines += 1
-            self.metrics.requests_failed += 1
-            self._flight("logit_quarantine", rid=req.rid, where="prefill",
-                         step=self._step_no)
+            self._quarantine(req.slot, req, self._step_no, where="prefill")
             return
         self._harvest(req, int(np.asarray(tok)[0]))
 
@@ -1206,16 +1535,8 @@ class ServingEngine:
         # blank on release, never park on the LRU where the next
         # identical prompt would reuse the poisoned KV
         if self.config.logit_guard and bool(np.asarray(bad)[0]):
-            slot = req.slot
-            if tr.enabled:
-                tr.instant("quarantine", cat="engine",
-                           args={"rid": req.rid, "where": "prefill_chunk"})
-            self.sched.fail(req, "corrupt_logits")
-            self._clear_slot_arrays(slot)
-            self.metrics.logit_quarantines += 1
-            self.metrics.requests_failed += 1
-            self._flight("logit_quarantine", rid=req.rid,
-                         where="prefill_chunk", step=self._step_no)
+            self._quarantine(req.slot, req, self._step_no,
+                             where="prefill_chunk")
             return
         self._commit_full_blocks(req)
         if req.prefill_done < req.prefill_target:
@@ -1319,6 +1640,59 @@ class ServingEngine:
 
         return dequantize_params(qparams, self.engine._dequant_meta,
                                  self.engine.compute_dtype)
+
+    def _build_mixed_step(self):
+        """The ONE resident serving program. Shapes are fixed — a packed
+        ``[1, mixed_tokens]`` ragged token batch against the full pool —
+        and EVERYTHING ragged rides as data: per-token table rows and
+        absolute positions, per-slot segment offsets/lengths, chunk
+        starts, context lengths, block tables. Decode rows and prefill
+        chunks share the unified ragged attention grid
+        (``ops/pallas/ragged_attention.py`` on TPU, the packed XLA
+        reference elsewhere), every row samples its last valid position,
+        and the host keeps only the tokens it asked for — so any traffic
+        mix, chunk schedule or cache-hit pattern reuses ONE executable."""
+        module, scfg = self.engine.module, self.config
+        T = self._mixed_tokens
+
+        def mixed_step(params, pool, tables, ids, token_rows, append_pos,
+                       row_start, row_len, chunk_start, context_len,
+                       corrupt, rng):
+            # trace-time side effect: runs once per XLA compile
+            self.compile_counts["mixed_step"] += 1
+            self.perf.note_compile("mixed_step")
+            self.tracer.instant("xla_compile", cat="engine",
+                                args={"kind": "mixed_step"})
+            params = self._dequant(params)
+            idx = paged_cache_index(tables, append_pos, context_len,
+                                    chunk_start=chunk_start,
+                                    token_rows=token_rows,
+                                    query_start=row_start,
+                                    query_len=row_len)
+            logits, pool = module.apply({"params": params}, ids, cache=pool,
+                                        cache_index=idx)
+            # each row's last valid packed position: the next token for
+            # decode rows, token one for a final chunk, discarded for
+            # mid-prompt chunks; inert rows read position 0 (never
+            # consumed by the host)
+            last_idx = jnp.clip(row_start + row_len - 1, 0, T - 1)
+            last = logits[0, last_idx]
+            # corrupt_logits chaos: NaN flagged rows as DATA (no recompile)
+            last = jnp.where(corrupt[:, None],
+                             jnp.asarray(jnp.nan, last.dtype), last)
+            # output guard: per-row NaN/Inf flag, computed on-device
+            bad = ~jnp.isfinite(last).all(axis=-1)
+            tok = _sample_logits(last, rng, scfg.do_sample,
+                                 scfg.temperature, scfg.top_k, scfg.top_p)
+            return tok.astype(jnp.int32), bad, pool
+
+        # explicit shardings, exactly like the dense engine's generate: TP
+        # params keep their NamedShardings, everything else replicates
+        r = self.engine._replicated
+        return jax.jit(mixed_step, donate_argnums=self._donate,
+                       in_shardings=(self.engine.param_shardings,)
+                       + (r,) * 11,
+                       out_shardings=(r, r, r))
 
     def _build_decode(self):
         module, scfg = self.engine.module, self.config

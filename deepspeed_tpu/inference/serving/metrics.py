@@ -73,12 +73,14 @@ class ServingMetrics:
     blocks_cached: int = 0
     #: cached pages reclaimed to back new allocations (pool monotone)
     prefix_evictions: int = 0
-    #: residents still owed prefill chunks this step
-    chunked_prefill_waiting: int = 0
-    #: age (s) of the OLDEST request still owed prefill chunks — the
-    #: chunked-prefill queue-age signal: it climbing means the prefill
-    #: token budget is starving long prompts
-    chunked_prefill_queue_age_s: float = 0.0
+    #: residents still owed prefill tokens this step (the unified step's
+    #: packed-budget backlog; formerly ``chunked_prefill_waiting`` — the
+    #: sentinel-row framing died with the two-program engine)
+    prefill_waiting: int = 0
+    #: age (s) of the OLDEST request still owed prefill tokens — it
+    #: climbing means the per-step prefill token budget is starving long
+    #: prompts (formerly ``chunked_prefill_queue_age_s``)
+    prefill_queue_age_s: float = 0.0
     brownout_active: bool = False
     # -- performance accounting (monitor/perf.py; engine-written each
     # step). None = not yet captured, or the value needs a device peak /
@@ -94,6 +96,17 @@ class ServingMetrics:
     #: the honest hardware-efficiency gauge for serving
     decode_mbu: Optional[float] = None
     decode_tokens_per_sec_per_chip: Optional[float] = None
+    #: unified mixed step (the default engine's ONE resident program):
+    #: per-call cost + utilization — decode_* above are written only by
+    #: the legacy two-program engine
+    mixed_flops_per_step: Optional[float] = None
+    mixed_bytes_per_step: Optional[float] = None
+    mixed_mfu: Optional[float] = None
+    #: model BANDWIDTH utilization of the mixed step — still the honest
+    #: serving gauge (the step is dominated by the param + KV read)
+    mixed_mbu: Optional[float] = None
+    #: packed tokens (decode + computed prefill) per second per chip
+    mixed_tokens_per_sec_per_chip: Optional[float] = None
     #: recompile-sentinel alarms: resident programs whose argument
     #: fingerprint changed (each one names the offender in the trace)
     recompiles: int = 0
@@ -170,8 +183,8 @@ class ServingMetrics:
             "prefix_evictions": float(self.prefix_evictions),
             "kv_blocks_cached": float(self.blocks_cached),
             "cow_copies": float(self.cow_copies),
-            "chunked_prefill_waiting": float(self.chunked_prefill_waiting),
-            "chunked_prefill_queue_age_s": self.chunked_prefill_queue_age_s,
+            "prefill_waiting": float(self.prefill_waiting),
+            "prefill_queue_age_s": self.prefill_queue_age_s,
             "requests_submitted": float(self.requests_submitted),
             "requests_completed": float(self.requests_completed),
             "requests_failed": float(self.requests_failed),
@@ -191,6 +204,9 @@ class ServingMetrics:
         for key in ("decode_flops_per_step", "decode_bytes_per_step",
                     "decode_mfu", "decode_mbu",
                     "decode_tokens_per_sec_per_chip",
+                    "mixed_flops_per_step", "mixed_bytes_per_step",
+                    "mixed_mfu", "mixed_mbu",
+                    "mixed_tokens_per_sec_per_chip",
                     "hbm_bytes_in_use", "hbm_peak_bytes"):
             v = getattr(self, key)
             if v is not None:

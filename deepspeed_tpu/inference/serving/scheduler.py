@@ -29,7 +29,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ...monitor.tracing import NULL_TRACER, Tracer
 from .block_pool import BlockPool, ChainKey
@@ -350,6 +350,40 @@ class Scheduler:
         if len(self.admit_log) > 65536:  # bounded on long-lived servers
             del self.admit_log[:len(self.admit_log) - 65536]
         return req
+
+    # -- mixed-step prefill packing ------------------------------------
+
+    def plan_prefill_grants(self, budget: int, chunk: int
+                            ) -> "Dict[str, int]":
+        """Split this step's prefill token ``budget`` across mid-prefill
+        residents: round-robin ``chunk``-sized grants in admission order
+        until the budget is gone or nobody is owed tokens. Grants to one
+        request are CONTIGUOUS prompt tokens, so several rounds simply
+        extend its packed segment — the unified mixed step packs each
+        ``{rid: tokens}`` entry as one ragged row. Pure planning: no
+        request state changes here (the engine commits after the packed
+        dispatch lands)."""
+        grants: Dict[str, int] = {}
+        if budget <= 0 or chunk <= 0:
+            return grants
+        pending = sorted((r for _, r in self.active() if r.prefilling),
+                         key=lambda r: r.admit_order)
+        while budget > 0:
+            progressed = False
+            for req in pending:
+                if budget <= 0:
+                    break
+                owed = (req.prefill_target - req.prefill_done
+                        - grants.get(req.rid, 0))
+                n = min(chunk, budget, owed)
+                if n <= 0:
+                    continue
+                grants[req.rid] = grants.get(req.rid, 0) + n
+                budget -= n
+                progressed = True
+            if not progressed:
+                break
+        return grants
 
     # -- decode-time page growth / preemption --------------------------
 

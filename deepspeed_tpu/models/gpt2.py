@@ -20,6 +20,7 @@ from .layers import (cached_attention_xla,
                      init_kv_cache, init_paged_kv_cache, is_paged_index,
                      key_mask_to_bias, paged_attention_reference,
                      paged_prefill_attention_reference,
+                     ragged_mixed_attention_reference,
                      shift_labels, update_kv_cache, update_paged_kv_cache)
 
 
@@ -71,7 +72,13 @@ class GPT2Attention(nn.Module):
         if layer_cache is not None and is_paged_index(cache_index):
             # paged serving path (inference/serving/): see LlamaAttention
             layer_cache = update_paged_kv_cache(layer_cache, k, v, cache_index)
-            if T == 1:
+            if "token_rows" in cache_index:
+                # unified ragged MIXED step: packed decode rows + prefill
+                # chunks on one grid (see LlamaAttention; gpt2 always
+                # takes the XLA reference)
+                out = ragged_mixed_attention_reference(q, layer_cache,
+                                                       cache_index)
+            elif T == 1:
                 out = paged_attention_reference(
                     q[:, 0], layer_cache, cache_index["block_tables"],
                     cache_index["context_len"])[:, None]

@@ -371,7 +371,8 @@ def init_paged_kv_cache(num_blocks: int, block_size: int, num_kv_heads: int,
 
 
 def paged_cache_index(block_tables: jnp.ndarray, append_pos: jnp.ndarray,
-                      context_len: jnp.ndarray, chunk_start=None):
+                      context_len: jnp.ndarray, chunk_start=None,
+                      token_rows=None, query_start=None, query_len=None):
     """Bundle the per-sequence paging state that rides through the model as
     ``cache_index`` (a plain dict threads the flax scan carry unchanged).
 
@@ -387,12 +388,35 @@ def paged_cache_index(block_tables: jnp.ndarray, append_pos: jnp.ndarray,
     path: absolute position of the chunk's first token. Its presence
     switches the models' multi-token paged branch from fresh-KV (from-
     empty) attention to pool attention over the cached prefix + chunk.
+
+    **Packed ragged MIXED batch** (the serving engine's unified step —
+    "Ragged Paged Attention", arxiv 2604.15464): the token axis is a flat
+    PACKED batch of contiguous per-sequence segments — decode rows
+    (1 token) and prefill chunks (many) side by side — and raggedness
+    rides three extra descriptor arrays, never the compiled shape:
+
+    - ``token_rows``: int32 same shape as ``append_pos`` — for each packed
+      token, the row of ``block_tables``/``context_len`` it belongs to
+      (``-1`` = padding; its KV write is dropped). Its presence switches
+      the models to the unified ragged attention path.
+    - ``query_start``: int32 ``[R]`` — each row's first token's offset in
+      the packed token axis (rows with no tokens this step: length 0).
+    - ``query_len``: int32 ``[R]`` — each row's packed segment length
+      (decode rows 1, prefill chunks n, inactive rows 0).
+
+    ``block_tables``/``context_len``/``chunk_start`` are then per-ROW
+    ``[R, nb_max]``/``[R]``/``[R]`` while ``append_pos``/``token_rows``
+    stay per-token.
     """
     out = {"block_tables": jnp.asarray(block_tables, jnp.int32),
            "append_pos": jnp.asarray(append_pos, jnp.int32),
            "context_len": jnp.asarray(context_len, jnp.int32)}
     if chunk_start is not None:
         out["chunk_start"] = jnp.asarray(chunk_start, jnp.int32)
+    if token_rows is not None:
+        out["token_rows"] = jnp.asarray(token_rows, jnp.int32)
+        out["query_start"] = jnp.asarray(query_start, jnp.int32)
+        out["query_len"] = jnp.asarray(query_len, jnp.int32)
     return out
 
 
@@ -414,12 +438,20 @@ def update_paged_kv_cache(layer_cache, k, v, cache_index):
     pos = cache_index["append_pos"]                       # [B, T]
     blk = jnp.maximum(pos, 0) // bs
     off = jnp.maximum(pos, 0) % bs
-    bids = jnp.take_along_axis(
-        cache_index["block_tables"],
-        jnp.minimum(blk, cache_index["block_tables"].shape[1] - 1), axis=1)
-    # drop pads AND positions beyond the table width (over-length appends
-    # must never alias another sequence's page)
-    valid = (pos >= 0) & (blk < cache_index["block_tables"].shape[1])
+    tables = cache_index["block_tables"]
+    nb = tables.shape[1]
+    if "token_rows" in cache_index:
+        # packed ragged mixed batch: each token names its OWN table row —
+        # the batch axis of ``pos`` no longer lines up with the tables'
+        rows = cache_index["token_rows"]                  # [B, T]
+        bids = tables[jnp.clip(rows, 0, tables.shape[0] - 1),
+                      jnp.minimum(blk, nb - 1)]
+        valid = (pos >= 0) & (rows >= 0) & (blk < nb)
+    else:
+        bids = jnp.take_along_axis(tables, jnp.minimum(blk, nb - 1), axis=1)
+        # drop pads AND positions beyond the table width (over-length
+        # appends must never alias another sequence's page)
+        valid = (pos >= 0) & (blk < nb)
     bids = jnp.where(valid, bids, num_blocks)             # OOB -> dropped
     if "k_scale" in layer_cache:
         kq, ks = _quantize_kv(k)
@@ -530,6 +562,77 @@ def paged_prefill_attention_reference(q, layer_cache, block_tables,
     logits = jnp.einsum("bqhd,bhsd->bhqs", q, k).astype(jnp.float32) * scale
     probs = jax.nn.softmax(logits + bias, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqs,bhsd->bqhd", probs, v)
+
+
+def ragged_mixed_attention_reference(q, layer_cache, cache_index,
+                                     window: Optional[int] = None,
+                                     scale: Optional[float] = None):
+    """Unified ragged mixed-batch attention over the paged pool, pure-XLA
+    fallback — the reference semantics of the serving engine's ONE
+    resident step ("Ragged Paged Attention", arxiv 2604.15464).
+
+    ``q``: ``[B, T, H, D]`` where the token axis is a PACKED ragged batch
+    (decode rows of 1 token and prefill chunks side by side, KV ALREADY
+    appended); ``cache_index`` is the packed bundle from
+    :func:`paged_cache_index` (``token_rows`` maps each token to its
+    block-table row). Masking is the chunked-prefill rule applied per
+    packed token — query at absolute position p sees its row's kv
+    positions ``<= p`` (and ``< context_len``) — so decode rows (one
+    token at ``context_len - 1``) and chunk rows share one definition by
+    construction; padding tokens (``token_rows < 0``) see nothing and
+    return finite garbage the caller never reads.
+
+    Cost shape: pages are gathered dense once per ROW (``[R, Hkv, S,
+    D]``), then expanded to a per-TOKEN ``[B*T, Hkv, S, D]`` via a
+    contiguous-row copy — ~``T/R``x the volume the split decode
+    reference paid, the price of one fixed-shape program over variable
+    segments (a per-row formulation needs data-dependent query shapes;
+    the earlier per-token PAGE-walk gather + ``repeat_kv`` cost ~2x this
+    form). GQA heads ride a grouped einsum, never a materialized
+    ``repeat_kv``. On TPU the real kernel
+    (``ops/pallas/ragged_attention.py ragged_paged_attention``) pays
+    none of this — dead q-tiles are skipped and pages stream per row.
+    """
+    B, T, H, D = q.shape
+    tables = cache_index["block_tables"]                  # [R, nb]
+    R = tables.shape[0]
+    num_blocks, Hkv, bs, _ = layer_cache["k"].shape
+    rows = cache_index["token_rows"].reshape(B * T)       # [B*T]
+    pos = jnp.asarray(cache_index["append_pos"], jnp.int32).reshape(B * T)
+    safe = jnp.clip(rows, 0, R - 1)
+    clen_row = jnp.asarray(cache_index["context_len"], jnp.int32)
+    # dense per-ROW K/V in the pool's head-major layout [R, Hkv, S, D] —
+    # NO GQA expansion (grouped einsum below) and no seq-major transpose
+    bt = jnp.minimum(jnp.asarray(tables, jnp.int32), num_blocks - 1)
+    S = bt.shape[1] * bs
+    k = layer_cache["k"][bt]                              # [R, nb, Hkv, bs, D]
+    v = layer_cache["v"][bt]
+    if "k_scale" in layer_cache:
+        k = dequantize_kv(k, layer_cache["k_scale"][bt], q.dtype)
+        v = dequantize_kv(v, layer_cache["v_scale"][bt], q.dtype)
+    else:
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    k = jnp.swapaxes(k, 1, 2).reshape(R, Hkv, S, D)
+    v = jnp.swapaxes(v, 1, 2).reshape(R, Hkv, S, D)
+    k = k[safe]                                           # [N, Hkv, S, D]
+    v = v[safe]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    G = H // Hkv
+    qg = q.reshape(B * T, Hkv, G, D)
+    logits = jnp.einsum("nhgd,nhsd->nhgs", qg, k).astype(jnp.float32) \
+        * scale
+    q_pos = pos[:, None]                                  # [N, 1]
+    clen = jnp.where((rows >= 0) & (pos >= 0), clen_row[safe], 0)
+    kv_pos = jnp.arange(S)[None, :]
+    visible = (kv_pos <= q_pos) & (kv_pos < clen[:, None])
+    if window is not None:
+        visible = visible & (q_pos - kv_pos < window)
+    bias = jnp.where(visible, 0.0, -1e9).astype(jnp.float32)[:, None, None]
+    probs = jax.nn.softmax(logits + bias, axis=-1).astype(q.dtype)
+    out = jnp.einsum("nhgs,nhsd->nhgd", probs, v)
+    return out.reshape(B, T, H, D)
 
 
 def copy_paged_blocks(pool, src_ids, dst_ids):

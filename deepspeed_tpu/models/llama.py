@@ -27,7 +27,8 @@ from .layers import (RMSNorm, apply_rotary,
                      dot_product_attention, init_kv_cache,
                      init_paged_kv_cache, is_paged_index, key_mask_to_bias,
                      paged_attention_reference,
-                     paged_prefill_attention_reference, repeat_kv,
+                     paged_prefill_attention_reference,
+                     ragged_mixed_attention_reference, repeat_kv,
                      resolve_remat_policy, rotary_embedding, shift_labels,
                      update_kv_cache, update_paged_kv_cache)
 
@@ -141,7 +142,32 @@ class LlamaAttention(nn.Module):
             # table; ragged-ness (per-sequence lengths) lives in the index
             # bundle, so ONE compiled step serves any mix of lengths
             layer_cache = update_paged_kv_cache(layer_cache, k, v, cache_index)
-            if T == 1:
+            if "token_rows" in cache_index:
+                # unified ragged MIXED step (the serving engine's ONE
+                # resident program): the token axis is a packed batch of
+                # per-sequence segments — decode rows and prefill chunks
+                # side by side — and raggedness rides the descriptor
+                # arrays (query_start/len, chunk_start, context_len) as
+                # DATA, so any traffic mix reuses one compiled step
+                if cfg.decode_attention_impl == "pallas":
+                    from ..ops.pallas.ragged_attention import \
+                        ragged_paged_attention
+
+                    out = ragged_paged_attention(
+                        q[0], layer_cache["k"], layer_cache["v"],
+                        cache_index["block_tables"],
+                        cache_index["query_start"],
+                        cache_index["query_len"],
+                        cache_index["chunk_start"],
+                        cache_index["context_len"],
+                        k_scale=layer_cache.get("k_scale"),
+                        v_scale=layer_cache.get("v_scale"),
+                        window=cfg.sliding_window)[None]
+                else:
+                    out = ragged_mixed_attention_reference(
+                        q, layer_cache, cache_index,
+                        window=cfg.sliding_window)
+            elif T == 1:
                 if cfg.decode_attention_impl == "pallas":
                     from ..ops.pallas.decode_attention import \
                         paged_decode_attention

@@ -232,7 +232,7 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# Paged (block-table) decode attention — the serving layer's kernel
+# Paged (block-table) decode attention — the LEGACY serving engine's kernel
 #
 # Same online-softmax pass as the dense kernel above, but the KV operand is
 # the SHARED block pool ``[N, Hkv, bs, D]`` (models/layers.py
@@ -242,6 +242,12 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
 # fixed-shape program serves every mix of sequence lengths — ragged-ness
 # lives entirely in the prefetched block tables / context lengths, never in
 # the compiled shape.
+#
+# The default serving engine now runs the UNIFIED kernel
+# (ops/pallas/ragged_attention.py): decode rows and prefill chunks on one
+# packed grid. The split decode/prefill kernels below remain as the legacy
+# (ServingConfig.mixed_step=False) path and as the per-row ground truth the
+# unified kernel's parity tests are pinned against.
 # ---------------------------------------------------------------------------
 
 

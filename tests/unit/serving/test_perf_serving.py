@@ -1,11 +1,11 @@
 """Serving performance accounting: the compiled-program table behind the
-"ONE decode compile" invariant, the recompile sentinel as a runtime alarm
-(forced shape violation → a named offender), MFU/MBU snapshot fields, and
-memory watermarks (graceful absence on CPU, monotone peak under a storm
-on real HBM).
+"ONE resident serving compile" invariant (the unified mixed step), the
+recompile sentinel as a runtime alarm (forced shape violation → a named
+offender), MFU/MBU snapshot fields, and memory watermarks (graceful
+absence on CPU, monotone peak under a storm on real HBM).
 
 Compile budget: one module-scoped prefix-cache engine serves the fast
-tests; the forced-recompile drill deliberately pays ONE extra decode
+tests; the forced-recompile drill deliberately pays ONE extra mixed-step
 compile and runs against its own engine so the shared table stays
 clean."""
 
@@ -45,17 +45,17 @@ def srv(llama_engine):
     return eng
 
 
-def test_program_table_carries_the_two_resident_compiles(srv):
+def test_program_table_carries_the_one_resident_compile(srv):
     table = {r["name"]: r for r in srv.perf.programs.table()}
-    assert set(table) == {"serving/decode", "serving/chunked_prefill"}
-    for row in table.values():
-        assert row["compiles"] == 1, row       # the resident invariant
-        assert row["recompiles"] == 0
-        assert row["calls"] >= 1
-        assert row["fingerprint"] and len(row["fingerprint"]) == 10
-        assert row["flops"] and row["flops"] > 0
-    assert srv.compile_counts == {"decode": 1, "prefill": 0,
-                                  "chunked_prefill": 1}
+    # the retired chunked_prefill / decode entries must be GONE, not 0
+    assert set(table) == {"serving/mixed_step"}
+    row = table["serving/mixed_step"]
+    assert row["compiles"] == 1, row           # the resident invariant
+    assert row["recompiles"] == 0
+    assert row["calls"] >= 1
+    assert row["fingerprint"] and len(row["fingerprint"]) == 10
+    assert row["flops"] and row["flops"] > 0
+    assert srv.compile_counts == {"mixed_step": 1}
 
 
 def test_cost_model_and_estimate_agree_on_magnitude(srv):
@@ -64,12 +64,8 @@ def test_cost_model_and_estimate_agree_on_magnitude(srv):
     into ops the cost model barely counts), so this is a drift alarm —
     same order of magnitude — not a precision claim; the exact 5% bar
     lives on hand-countable matmul programs in test_perf_accounting."""
-    from deepspeed_tpu.monitor.perf import estimate_decode_step_flops
-
-    prog = srv.perf.programs.program("decode")
-    est = estimate_decode_step_flops(srv.engine.module.config,
-                                     srv.config.max_batch_size,
-                                     srv.config.max_model_len)
+    prog = srv.perf.programs.program("mixed_step")
+    est = srv._mixed_cost_estimate()["flops"]
     assert prog.cost_source == "cost_model"
     assert 0.2 <= prog.flops / est <= 5.0, (prog.flops, est)
 
@@ -77,12 +73,15 @@ def test_cost_model_and_estimate_agree_on_magnitude(srv):
 def test_snapshot_carries_perf_fields(srv):
     snap = srv.metrics.snapshot()
     assert snap["recompiles"] == 0.0
-    assert snap["decode_flops_per_step"] > 0
-    assert snap["decode_bytes_per_step"] > 0
-    assert snap["decode_tokens_per_sec_per_chip"] > 0
+    assert snap["mixed_flops_per_step"] > 0
+    assert snap["mixed_bytes_per_step"] > 0
+    assert snap["mixed_tokens_per_sec_per_chip"] > 0
     if jax.devices()[0].platform == "cpu":
         # no device peak, no allocator stats: fields ABSENT, never fake
-        for key in ("decode_mfu", "decode_mbu", "hbm_bytes_in_use",
+        # (decode_* gauges belong to the legacy engine and stay absent on
+        # the unified one)
+        for key in ("mixed_mfu", "mixed_mbu", "decode_flops_per_step",
+                    "decode_mfu", "decode_mbu", "hbm_bytes_in_use",
                     "hbm_peak_bytes"):
             assert key not in snap, key
 
@@ -90,14 +89,13 @@ def test_snapshot_carries_perf_fields(srv):
 def test_perf_summary_shape(srv):
     s = srv.perf_summary()
     assert s["compile_counts"] == srv.compile_counts
-    assert {r["name"] for r in s["programs"]} == {"serving/decode",
-                                                  "serving/chunked_prefill"}
-    assert "decode" in s["utilization"]
-    assert s["utilization"]["decode"]["flops_per_step"] > 0
+    assert {r["name"] for r in s["programs"]} == {"serving/mixed_step"}
+    assert "mixed_step" in s["utilization"]
+    assert s["utilization"]["mixed_step"]["flops_per_step"] > 0
 
 
 def test_forced_recompile_trips_sentinel_naming_the_argument(llama_engine):
-    """The acceptance drill: violate the resident decode program's shape
+    """The acceptance drill: violate the resident mixed program's shape
     contract (block table one page wider) through the REAL dispatch path.
     The program genuinely recompiles (compile_counts 1 → 2) and the
     sentinel emits a trace event + counters naming `tables` with the
@@ -107,22 +105,24 @@ def test_forced_recompile_trips_sentinel_naming_the_argument(llama_engine):
         trace=True))
     rid = eng.submit(np.arange(1, 9), max_new_tokens=4)
     eng.run()
-    assert eng.compile_counts["decode"] == 1
-    B = eng.config.max_batch_size
+    assert eng.compile_counts["mixed_step"] == 1
+    B, T = eng.config.max_batch_size, eng.mixed_step_tokens
     widened = jnp.asarray(np.concatenate(
         [eng._tables, np.full((B, 1), eng.block_pool.sentinel, np.int32)],
         axis=1))
-    eng._decode_dispatch(eng.pool, widened, jnp.asarray(eng._seq_lens),
-                         jnp.asarray(eng._last_tok),
-                         jnp.zeros((B,), bool), jax.random.PRNGKey(7))
-    assert eng.compile_counts["decode"] == 2      # a REAL recompile
+    zt = jnp.zeros((1, T), jnp.int32)
+    zr = jnp.zeros((B,), jnp.int32)
+    eng._mixed_dispatch((eng.engine.params, eng.pool, widened, zt, zt, zt,
+                         zr, zr, zr, zr, jnp.zeros((B,), bool),
+                         jax.random.PRNGKey(7)))
+    assert eng.compile_counts["mixed_step"] == 2  # a REAL recompile
     assert eng.perf.recompile_total == 1
     assert eng.metrics.registry.counter("recompiles",
-                                        program="decode").value == 1
+                                        program="mixed_step").value == 1
     evs = [e for e in eng.tracer.events() if e["name"] == "recompile"]
     assert len(evs) == 1
     args = evs[0]["args"]
-    assert args["program"] == "decode"
+    assert args["program"] == "mixed_step"
     assert args["args"] == ["tables"]             # the offender, by name
     old, new = args["changed"]["tables"]
     assert old == "int32[2,4]" and new == "int32[2,5]"
@@ -138,7 +138,7 @@ def test_watchdogged_engine_keeps_accounting(llama_engine):
     eng.submit(np.arange(1, 9), max_new_tokens=4)
     outs = eng.run()
     assert all(o.state == "finished" for o in outs.values())
-    prog = eng.perf.programs.program("decode")
+    prog = eng.perf.programs.program("mixed_step")
     assert prog.compiles == 1 and prog.flops and prog.recompiles == 0
 
 

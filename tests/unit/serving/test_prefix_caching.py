@@ -289,13 +289,12 @@ def _reference(engine, prompt, max_new):
         do_sample=False))[0]]
 
 
-def test_acceptance_shared_prefix_token_identical_two_resident_compiles(
+def test_acceptance_shared_prefix_token_identical_one_resident_compile(
         srv_pc, llama_engine):
     """THE acceptance test: shared-prefix traffic through the prefix cache
-    + chunked prefill is token-identical to uncached per-request generate,
-    with EXACTLY the two resident programs compiled — one ragged decode,
-    one chunked prefill; the bucketed prefill never runs and nothing
-    recompiles across chunk positions or hit lengths."""
+    is token-identical to uncached per-request generate, with EXACTLY ONE
+    resident program compiled — the unified mixed step; nothing recompiles
+    across chunk positions, hit lengths or traffic mixes."""
     vocab = llama_engine.module.config.vocab_size
     rs = np.random.RandomState(0)
     prefix = rs.randint(1, vocab, 24)           # 3 full blocks
@@ -310,9 +309,7 @@ def test_acceptance_shared_prefix_token_identical_two_resident_compiles(
              for t, n in ((3, 6), (5, 4), (9, 5), (2, 7), (6, 4), (4, 6))]
     rids = [srv_pc.submit(p, max_new_tokens=n) for p, n in specs]
     outs = srv_pc.run()
-    assert srv_pc.compile_counts == {"decode": 1, "prefill": 0,
-                                     "chunked_prefill": 1}, \
-        srv_pc.compile_counts
+    assert srv_pc.compile_counts == {"mixed_step": 1}, srv_pc.compile_counts
     for rid, (p, n) in zip(rids, specs):
         o = outs[rid]
         assert o.state == "finished"
@@ -415,7 +412,7 @@ def test_preemption_with_prefix_cache_keeps_outputs_exact(llama_engine):
         assert outs[rid].tokens == _reference(llama_engine, p, 10)
     srv.block_pool.check_consistent()
     assert srv.block_pool.used_count == 0
-    assert srv.compile_counts["prefill"] == 0
+    assert srv.compile_counts == {"mixed_step": 1}
 
 
 def test_eviction_churn_many_distinct_prompts(llama_engine):
@@ -541,8 +538,7 @@ def test_chaos_storm_prefix_cache_no_leaks_no_stranded_blocks(llama_engine,
     srv.run()
     assert srv.poll(r).state == "finished"
     assert srv.metrics.cached_prefill_tokens > cached_before
-    assert srv.compile_counts == {"decode": 1, "prefill": 0,
-                                  "chunked_prefill": 1}
+    assert srv.compile_counts == {"mixed_step": 1}
 
 
 def test_poisoned_prefill_never_enters_the_cache(llama_engine, monkeypatch):
@@ -628,7 +624,7 @@ def test_wedged_prefill_chunk_trips_watchdog_keeps_serving(llama_engine,
         steps += 1
         assert steps < 400
     assert srv.poll(ok).state == "finished"
-    assert srv.compile_counts["chunked_prefill"] == 1  # no recompiles
+    assert srv.compile_counts == {"mixed_step": 1}  # no recompiles
 
 
 def test_negative_chunk_knobs_rejected_at_construction(llama_engine):
@@ -647,7 +643,7 @@ def test_metrics_snapshot_exports_prefix_counters(srv_pc):
     for key in ("prefix_hit_rate", "cached_prefill_tokens",
                 "prefill_tokens_computed", "prefix_evictions",
                 "kv_blocks_cached", "cow_copies", "served_tokens",
-                "chunked_prefill_waiting", "chunked_prefill_queue_age_s"):
+                "prefill_waiting", "prefill_queue_age_s"):
         assert key in snap, key
     assert snap["served_tokens"] >= snap["tokens_generated"]
 
@@ -655,7 +651,7 @@ def test_metrics_snapshot_exports_prefix_counters(srv_pc):
 @pytest.mark.slow
 def test_chunked_prefill_without_prefix_cache_parity(llama_engine):
     """Chunked prefill alone (no caching): still token-identical, still
-    one chunked-prefill compile, zero bucketed prefills."""
+    exactly one resident compile."""
     vocab = llama_engine.module.config.vocab_size
     rs = np.random.RandomState(19)
     srv = ServingEngine(llama_engine, ServingConfig(
@@ -666,8 +662,7 @@ def test_chunked_prefill_without_prefix_cache_parity(llama_engine):
     outs = srv.run()
     for p, rid in zip(prompts, rids):
         assert outs[rid].tokens == _reference(llama_engine, p, 5)
-    assert srv.compile_counts == {"decode": 1, "prefill": 0,
-                                  "chunked_prefill": 1}
+    assert srv.compile_counts == {"mixed_step": 1}
     assert srv.metrics.cached_prefill_tokens == 0  # caching stayed off
     srv.block_pool.check_consistent()
     assert srv.block_pool.used_count == 0

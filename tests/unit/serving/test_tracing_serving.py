@@ -238,7 +238,7 @@ def test_dump_trace_perfetto_loadable_and_viewable(srv):
     assert doc["displayTimeUnit"] == "ms"
     evs = doc["traceEvents"]
     assert evs and all(validate_event(e) is None for e in evs)
-    assert {"decode_step", "request", "submit"} <= {e["name"] for e in evs}
+    assert {"mixed_step", "request", "submit"} <= {e["name"] for e in evs}
     # tools/trace_view.py accepts it and reconstructs request timelines
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:

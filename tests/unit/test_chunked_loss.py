@@ -53,7 +53,11 @@ def test_function_parity_with_bias():
     assert np.allclose(l0, l1, rtol=1e-6, atol=1e-6)
 
 
-@pytest.mark.parametrize("tied", [False, True])
+@pytest.mark.parametrize("tied", [
+    # the untied head pays a second lm-head param tree (~42s on the CI
+    # box); the tied variant is the fast representative
+    pytest.param(False, marks=pytest.mark.slow),
+    True])
 def test_model_level_parity(tied):
     cfg_kw = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
                   num_hidden_layers=2, num_attention_heads=4,

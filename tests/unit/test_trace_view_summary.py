@@ -115,8 +115,8 @@ def test_single_file_mode_still_works(tmp_path, capsys):
 
 
 def test_summary_of_real_engine_trace(tmp_path):
-    """End-to-end: a real serving run's dump must summarize with the two
-    resident programs and no recompile events."""
+    """End-to-end: a real serving run's dump must summarize with the ONE
+    resident program (the unified mixed step) and no recompile events."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -139,8 +139,7 @@ def test_summary_of_real_engine_trace(tmp_path):
     srv.run()
     path = srv.dump_trace(str(tmp_path / "run.json"))
     s = trace_view.summarize([path])
-    assert s["xla_compiles"] == {"decode": 1, "chunked_prefill": 1}
+    assert s["xla_compiles"] == {"mixed_step": 1}
     assert s["recompiles"] == []
     assert s["requests"] == 3
-    assert "decode_step" in s["engine_spans"]
-    assert "prefill_chunk" in s["engine_spans"]
+    assert "mixed_step" in s["engine_spans"]

@@ -24,10 +24,12 @@ queue + prefill = TTFT by construction), plus decode time and totals.
 
 ``--summary`` aggregates ACROSS any number of trace/flight files — the
 whole-incident view a directory of dumps wants: per-program engine time
-share (decode vs chunked prefill vs bucketed prefill), per-request phase
-totals, XLA compile counts by kind, every recompile-sentinel event with
-the argument it named, and the worst-N requests by TTFT with the file
-each came from:
+share (the unified ``mixed_step``, or the old ``decode_step`` /
+``prefill_chunk`` pair — spans aggregate by NAME, so r8/r9-era dumps and
+unified-engine dumps both parse, even mixed in one ``--summary`` call),
+per-request phase totals, XLA compile counts by kind, every
+recompile-sentinel event with the argument it named, and the worst-N
+requests by TTFT with the file each came from:
 
   python tools/trace_view.py --summary /tmp/traces/*.json*
   python tools/trace_view.py --summary --worst 10 --json dir/*.jsonl
