@@ -14,11 +14,18 @@ reduce_scatter / all_to_all_single / send / recv / broadcast / barrier) and its
   records trace-time op/byte counts (every collective that enters the program)
   and leaves wall-clock attribution to the profiler. Bandwidth math mirrors
   ``deepspeed/utils/comms_logging.py:23``.
+- :func:`configure_comm_tracing` additionally arms per-collective
+  **observability**: each verb emits a ``comm:<op>`` tracer span and a
+  ``comm_op_s{op, dtype, bytes_bucket}`` registry histogram behind a
+  one-attribute-check guard (zero overhead disabled) — the per-op comm
+  mix ``trace_view --summary`` and ``ds_report`` aggregate.
 """
 
 import functools
+import time
+import weakref
 from enum import Enum
-from typing import Optional, Sequence, Tuple, Union
+from typing import Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +129,130 @@ def _record(op_name: str, x, axis: AxisName) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Per-collective observability: tracer spans + registry histograms
+# ---------------------------------------------------------------------------
+
+def _bytes_bucket(n: int) -> str:
+    """Pow2 size-class label for the histogram's ``bytes_bucket`` axis
+    (``<=4KiB``, ``<=1MiB``, ...): collectives of wildly different sizes
+    must not share one latency distribution."""
+    if n <= 0:
+        return "0B"
+    size = 1
+    while size < n:
+        size <<= 1
+    for unit, scale in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                        ("KiB", 1 << 10)):
+        if size >= scale:
+            return f"<={size // scale}{unit}"
+    return f"<={size}B"
+
+
+class CommObserver:
+    """Per-collective spans + histograms behind ONE attribute check.
+
+    When enabled, every module-level collective verb emits a
+    ``comm:<op>`` span (cat ``comm``; args carry op, dtype, payload
+    bytes, axis) into the wired tracer and observes its duration into a
+    ``comm_op_s{op=,dtype=,bytes_bucket=}`` histogram in the wired
+    registry — the per-op comm mix ``trace_view --summary`` aggregates.
+
+    Honesty note: these verbs are *traced* collectives — inside ``jit``/
+    ``shard_map`` a span measures the TRACE-TIME cost of staging the op
+    (once per compile), and the op/dtype/bytes **mix** is the durable
+    signal (which collectives, how big, how often a program re-stages
+    them); device wall-clock attribution stays the profiler's job
+    (``/profilez``). Under ``jax.disable_jit`` (or any eager path) the
+    spans are real wall time.
+
+    Disabled (the default) the verbs pay one attribute check and zero
+    allocations — the ``NULL_TRACER`` discipline of ``monitor/tracing``.
+
+    Sinks are held by WEAK reference (the AdminServer discipline): the
+    observer is process-global while tracers/registries belong to
+    engines, so a strong ref would pin a dropped engine's ring forever —
+    and keep every later (untraced) engine paying ``emit()`` into a dead
+    sink. When every configured sink dies, the observer disarms itself.
+    """
+
+    __slots__ = ("enabled", "_tracer_ref", "_registry_ref", "_hists")
+
+    def __init__(self):
+        self.enabled = False
+        self._tracer_ref = None
+        self._registry_ref = None
+        #: (op, dtype, bucket) -> Histogram, so the hot enabled path pays
+        #: one dict probe instead of a get-or-create label-format walk
+        self._hists: Dict[Tuple[str, str, str], object] = {}
+
+    @property
+    def tracer(self):
+        return self._tracer_ref() if self._tracer_ref is not None else None
+
+    @property
+    def registry(self):
+        return self._registry_ref() if self._registry_ref is not None \
+            else None
+
+    def emit(self, op: str, x, axis: AxisName, t0: float) -> None:
+        t1 = time.perf_counter()
+        tr = self.tracer
+        reg = self.registry
+        if tr is None and reg is None:
+            # the engine that armed us is gone: disarm so later untraced
+            # engines stop paying for its dead sinks
+            self.enabled = False
+            self._hists.clear()
+            return
+        nbytes = _nbytes(x)
+        dtype = str(getattr(x, "dtype", "?"))
+        if tr is not None and tr.enabled:
+            tr.complete(f"comm:{op}", t0, t1, cat="comm",
+                        args={"op": op, "bytes": nbytes, "dtype": dtype,
+                              "axis": str(axis)})
+        if reg is not None:
+            bucket = _bytes_bucket(nbytes)
+            key = (op, dtype, bucket)
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = reg.histogram(
+                    "comm_op_s", lo=1e-7, hi=1e2, op=op, dtype=dtype,
+                    bytes_bucket=bucket)
+            h.observe(t1 - t0)
+
+
+#: the module-level observer every collective verb guards on
+comm_observer = CommObserver()
+
+
+def configure_comm_tracing(tracer=None, registry=None) -> CommObserver:
+    """Arm per-collective observability: spans into ``tracer`` (default:
+    the process-global ``monitor.tracing.get_tracer()``) and latency/mix
+    histograms into ``registry`` (optional). The training engine calls
+    this when its tracing block is armed; call it directly for ad-hoc
+    runs. Module-global — the last caller wins (one process, one comm
+    observer, matching the one ``comms_logger``)."""
+    if tracer is None:
+        from ..monitor.tracing import get_tracer
+
+        tracer = get_tracer()
+    # weak refs: the observer is process-global, the sinks are engine-
+    # owned — arming must never extend an engine's lifetime (emit()
+    # disarms itself once every configured sink is gone)
+    comm_observer._tracer_ref = weakref.ref(tracer)
+    comm_observer._registry_ref = None if registry is None \
+        else weakref.ref(registry)
+    comm_observer._hists.clear()
+    comm_observer.enabled = True
+    return comm_observer
+
+
+def disable_comm_tracing() -> None:
+    comm_observer.enabled = False
+    comm_observer._hists.clear()
+
+
+# ---------------------------------------------------------------------------
 # Collective verbs — call inside shard_map over the current mesh.
 # ---------------------------------------------------------------------------
 
@@ -138,9 +269,7 @@ def _gather_reduce(tensor, group: AxisName, binop):
     return out
 
 
-def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
-    """Reference: ``comm.py:500``. SPMD: psum/pmax/pmin/pmean over an axis."""
-    _record("all_reduce", tensor, group)
+def _all_reduce_op(tensor, op: ReduceOp, group: AxisName):
     if op == ReduceOp.SUM:
         return lax.psum(tensor, group)
     if op == ReduceOp.AVG:
@@ -160,6 +289,16 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
     raise NotImplementedError(f"ReduceOp {op} not supported on XLA backend")
 
 
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
+    """Reference: ``comm.py:500``. SPMD: psum/pmax/pmin/pmean over an axis."""
+    _record("all_reduce", tensor, group)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = _all_reduce_op(tensor, op, group)
+    if t0:
+        comm_observer.emit("all_reduce", tensor, group, t0)
+    return out
+
+
 def all_gather(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = False):
     """Reference: ``comm.py:235`` (tensor-list form) / ``all_gather_base`` :304.
 
@@ -168,45 +307,67 @@ def all_gather(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = Fa
     flat-buffer semantics of ``all_gather_base``.
     """
     _record("all_gather", tensor, group)
-    return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+    if t0:
+        comm_observer.emit("all_gather", tensor, group, t0)
+    return out
 
 
 def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data",
                    scatter_dimension: int = 0):
     """Reference: ``reduce_scatter_base`` ``comm.py:289`` → psum_scatter."""
     _record("reduce_scatter", tensor, group)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
     if op == ReduceOp.AVG:
-        return lax.pmean_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True) \
+        out = lax.pmean_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True) \
             if hasattr(lax, "pmean_scatter") else (
             lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True)
             / lax.psum(1, group))
-    if op != ReduceOp.SUM:
+    elif op != ReduceOp.SUM:
         raise NotImplementedError("reduce_scatter supports SUM/AVG on XLA backend")
-    return lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True)
+    else:
+        out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True)
+    if t0:
+        comm_observer.emit("reduce_scatter", tensor, group, t0)
+    return out
 
 
 def all_to_all_single(tensor, group: AxisName = "expert", split_axis: int = 0,
                       concat_axis: int = 0, tiled: bool = True):
     """Reference: ``comm.py:355``. The MoE dispatch primitive."""
     _record("all_to_all_single", tensor, group)
-    return lax.all_to_all(tensor, group, split_axis=split_axis, concat_axis=concat_axis,
-                          tiled=tiled)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.all_to_all(tensor, group, split_axis=split_axis, concat_axis=concat_axis,
+                         tiled=tiled)
+    if t0:
+        comm_observer.emit("all_to_all_single", tensor, group, t0)
+    return out
 
 
 def broadcast(tensor, src: int = 0, group: AxisName = "data"):
     """Reference: ``comm.py:223``. SPMD: mask + psum (XLA lowers to a bcast)."""
     _record("broadcast", tensor, group)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
     idx = lax.axis_index(group)
     # where (not multiply-by-mask) so NaN/Inf in non-source shards — the very
     # buffers a broadcast exists to overwrite — cannot poison the psum.
     masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor, shape=()))
-    return lax.psum(masked, group)
+    out = lax.psum(masked, group)
+    if t0:
+        comm_observer.emit("broadcast", tensor, group, t0)
+    return out
 
 
 def permute(tensor, perm, group: AxisName = "pipe"):
-    """ppermute — the TPU-native send/recv. ``perm`` is [(src, dst), ...]."""
+    """ppermute — the TPU-native send/recv (``send_recv_next``/``_prev``
+    ride this, so p2p traffic shows up under op ``ppermute``)."""
     _record("ppermute", tensor, group)
-    return lax.ppermute(tensor, group, perm)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.ppermute(tensor, group, perm)
+    if t0:
+        comm_observer.emit("ppermute", tensor, group, t0)
+    return out
 
 
 def send_recv_next(tensor, group: AxisName = "pipe"):
@@ -237,7 +398,11 @@ def axis_size(group: AxisName = "data") -> int:
 
 
 def barrier(group: AxisName = "data"):
-    """No-op under SPMD — a compiled program is already bulk-synchronous."""
+    """No-op under SPMD — a compiled program is already bulk-synchronous.
+    Still observed when comm tracing is armed: code that barriers in a
+    hot loop is a smell the op-mix table should surface."""
+    if comm_observer.enabled:
+        comm_observer.emit("barrier", None, group, time.perf_counter())
     return None
 
 

@@ -122,6 +122,63 @@ def trace_report() -> None:
           + (f" (newest: {traces[-1]})" if traces else ""))
 
 
+def admin_report() -> None:
+    """Admin control-plane status (``monitor/export.py``): every live
+    admin server in THIS process with its port and last-scrape recency.
+    A fresh ``ds_report`` CLI run has no servers (they live inside
+    serving processes) — call from in-process (or a test) to see them."""
+    import time
+
+    from deepspeed_tpu.monitor.export import live_admin_servers
+
+    servers = live_admin_servers()
+    if not servers:
+        print("admin endpoints: none live in this process "
+              "(ds_serve --admin-port N serves /metrics /healthz /readyz "
+              "/statusz /profilez)")
+        return
+    now = time.time()
+    for s in servers:
+        if s.last_scrape_time is None:
+            scrape = "never scraped"
+        else:
+            scrape = (f"last /metrics scrape {now - s.last_scrape_time:.1f}s "
+                      f"ago ({s.scrape_count} total)")
+        print(f"admin endpoints: {s.url} — {scrape}")
+
+
+def comm_report() -> None:
+    """Per-collective comm-tracing table (``comm/comm.py``): when
+    ``configure_comm_tracing`` armed a registry and collectives ran, the
+    op/dtype/bytes-bucket histograms print here — which collectives a
+    run stages, how big, and their span-time distribution."""
+    from deepspeed_tpu.comm.comm import comm_observer
+    from deepspeed_tpu.monitor.export import split_key
+    from deepspeed_tpu.monitor.registry import Histogram
+
+    reg = comm_observer.registry
+    rows = []
+    if reg is not None:
+        for key, metric in reg.items():
+            name, labels = split_key(key)
+            if name == "comm_op_s" and isinstance(metric, Histogram) \
+                    and metric.count:
+                rows.append((labels.get("op", "?"),
+                             labels.get("dtype", "?"),
+                             labels.get("bytes_bucket", "?"), metric))
+    if not rows:
+        if comm_observer.enabled:
+            print("comm tracing: armed, no collectives recorded yet")
+        return  # disabled and empty: stay silent like the op table
+    print("-" * 60)
+    print(f"{'collective':<20}{'dtype':<10}{'bytes':>10}{'count':>8}"
+          f"{'p50':>10}{'p95':>10}")
+    for op, dtype, bucket, h in sorted(rows):
+        print(f"{op:<20}{dtype:<10}{bucket:>10}{h.count:>8}"
+              f"{h.percentile(0.5) * 1e6:>9.1f}u"
+              f"{h.percentile(0.95) * 1e6:>9.1f}u")
+
+
 def perf_report() -> None:
     """Performance-accounting status (``monitor/perf.py``): per-device
     memory stats and the resident compiled-program table (name,
@@ -224,7 +281,9 @@ def main(argv=None):
     env_info()
     fault_report()
     trace_report()
+    admin_report()
     perf_report()
+    comm_report()
     op_report()
     return 0
 

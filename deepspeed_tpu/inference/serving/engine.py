@@ -173,6 +173,16 @@ class ServingConfig:
     #: quarantine requests whose logits go NaN/Inf instead of emitting
     #: garbage tokens
     logit_guard: bool = True
+    # -- SLO / goodput --------------------------------------------------
+    #: time-to-first-token SLO (seconds, submit -> first token); a
+    #: finished request past it is attributed ``ttft_miss``. None = every
+    #: finished request is latency-``good`` (availability verdicts —
+    #: shed/failed — are still attributed)
+    ttft_slo_s: Optional[float] = None
+    #: time-per-output-token SLO (seconds/token over the decode phase);
+    #: a finished request whose mean inter-token latency exceeds it is
+    #: attributed ``tpot_miss``
+    tpot_slo_s: Optional[float] = None
     # -- tracing / flight recorder -------------------------------------
     #: record span timelines (per-request phases, prefill chunks, decode
     #: steps, compiles) into a bounded in-memory ring; export with
@@ -256,6 +266,12 @@ class ServingEngine:
                                self.nb_max, prefix_cache=cfg.prefix_cache,
                                tracer=self.tracer)
         self.metrics = ServingMetrics(blocks_total=cfg.num_blocks)
+        #: SLO attribution: every terminal transition (including gate-side
+        #: sheds that never pass through an engine method) funnels through
+        #: Scheduler._release, which calls this hook before emitting the
+        #: terminal span — so the verdict rides the span and the goodput
+        #: gauges see every request exactly once
+        self.sched.on_terminal = self._slo_on_terminal
         #: performance accounting: compiled-program registry + recompile
         #: sentinel (the runtime alarm behind the "ONE decode compile"
         #: invariant), cost-model FLOPs/bytes, MFU/MBU math, and HBM
@@ -311,6 +327,10 @@ class ServingEngine:
         #: the one abandoned watchdog thread, if still wedged in device
         #: compute — bounds thread growth to 1 under a persistent hang
         self._wedged: Optional[threading.Thread] = None
+        #: incident recency for the /healthz probe (perf_counter stamps;
+        #: None = never happened)
+        self._last_trip_time: Optional[float] = None
+        self._last_quarantine_time: Optional[float] = None
         self._mixed_fn = None
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
@@ -586,6 +606,99 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.sched.has_work()
 
+    # -- SLO attribution ------------------------------------------------
+
+    def _judge_slo(self, req: Request) -> str:
+        """One verdict per terminal request (metrics.SLO_VERDICTS):
+
+        - ``shed``      — cancelled (caller cancel, load shed, drain,
+                          displacement): the engine chose not to serve it;
+        - ``failed``    — engine-side failure (watchdog, quarantine,
+                          prefill error, pool exhaustion);
+        - ``ttft_miss`` — finished past the TTFT SLO, or timed out before
+                          producing a first token;
+        - ``tpot_miss`` — finished with mean inter-token latency past the
+                          TPOT SLO, or timed out mid-decode;
+        - ``good``      — finished inside both budgets (trivially, when
+                          no SLO is configured).
+        """
+        cfg = self.config
+        if req.state is RequestState.CANCELLED:
+            return "shed"
+        if req.state is RequestState.FAILED:
+            return "failed"
+        if req.state is RequestState.TIMEOUT:
+            # a deadline blown before the first token is a TTFT story; one
+            # blown mid-decode is a decode-rate story
+            return "ttft_miss" if req.first_token_time is None \
+                else "tpot_miss"
+        # FINISHED: judge against the configured budgets
+        if cfg.ttft_slo_s is not None and req.ttft is not None \
+                and req.ttft > cfg.ttft_slo_s:
+            return "ttft_miss"
+        if cfg.tpot_slo_s is not None and len(req.tokens) > 1 \
+                and req.first_token_time is not None \
+                and req.finish_time is not None:
+            tpot = (req.finish_time - req.first_token_time) \
+                / (len(req.tokens) - 1)
+            if tpot > cfg.tpot_slo_s:
+                return "tpot_miss"
+        return "good"
+
+    def _slo_on_terminal(self, req: Request) -> None:
+        verdict = self._judge_slo(req)
+        req.slo_verdict = verdict
+        self.metrics.note_slo(
+            verdict,
+            goodput_tokens=len(req.tokens) if verdict == "good" else 0)
+
+    # -- control-plane probes (monitor/export.py serves these) ----------
+
+    def health(self) -> "tuple[bool, Dict[str, Any]]":
+        """Liveness: can this engine make progress RIGHT NOW? False while
+        a watchdog-abandoned step is still wedged in device compute (the
+        engine is alive but every step skips the device — exactly the
+        state a router should route around). Detail carries incident
+        recency (last watchdog trip / quarantine age) for dashboards."""
+        now = time.perf_counter()
+        wedged = self._wedged is not None and self._wedged.is_alive()
+        detail: Dict[str, Any] = {
+            "wedged": wedged,
+            "steps": self.metrics.steps,
+            "watchdog_trips": self.metrics.watchdog_trips,
+            "logit_quarantines": self.metrics.logit_quarantines,
+            "last_watchdog_trip_age_s": None if self._last_trip_time is None
+            else round(now - self._last_trip_time, 3),
+            "last_quarantine_age_s": None
+            if self._last_quarantine_time is None
+            else round(now - self._last_quarantine_time, 3),
+        }
+        return (not wedged), detail
+
+    def readiness(self) -> "tuple[bool, Dict[str, Any]]":
+        """Readiness: should a router send NEW traffic here? Requires
+        admission open (not draining), KV headroom above the brownout
+        line, and the resident serving program compiled (a cold replica
+        answering ready would eat the fleet's tail latency with its first
+        compile). Detail names every failing bit."""
+        reasons = []
+        if self._draining:
+            reasons.append("draining")
+        if self.brownout:
+            reasons.append("brownout")
+        warm = self._mixed_warm if self._mixed else self._decode_warm
+        if not warm:
+            reasons.append("cold")
+        detail: Dict[str, Any] = {
+            "reasons": reasons,
+            "queue_depth": self.sched.queue_depth,
+            "kv_blocks_free": self.block_pool.num_blocks
+            - self.block_pool.used_count,
+            "kv_occupancy": round(self.block_pool.occupancy(), 4),
+            "resident_compiled": warm,
+        }
+        return (not reasons), detail
+
     # -- tracing / post-mortem -----------------------------------------
 
     def _flight(self, trigger: str, **detail) -> None:
@@ -789,6 +902,7 @@ class ServingEngine:
             except StepWatchdogTimeout as e:
                 log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
                 self.metrics.watchdog_trips += 1
+                self._last_trip_time = time.perf_counter()
                 rids = [r.rid for _, r in active]
                 if tr.enabled:
                     tr.instant("watchdog_trip", cat="engine",
@@ -1037,6 +1151,7 @@ class ServingEngine:
         except StepWatchdogTimeout as e:
             log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
             self.metrics.watchdog_trips += 1
+            self._last_trip_time = time.perf_counter()
             packed = [(s, r) for s, r in decodes] + \
                      [(s, r) for s, r, _, _ in prefills]
             rids = [r.rid for _, r in packed]
@@ -1135,6 +1250,7 @@ class ServingEngine:
         self.sched.fail(req, "corrupt_logits")
         self._clear_slot_arrays(slot)
         self.metrics.logit_quarantines += 1
+        self._last_quarantine_time = time.perf_counter()
         self.metrics.requests_failed += 1
         self._flight("logit_quarantine", rid=req.rid, slot=slot,
                      step=step_no, where=where)
@@ -1422,6 +1538,7 @@ class ServingEngine:
                     log_dist(f"serving: chunked prefill watchdog tripped "
                              f"for {req.rid}: {e}", ranks=[0])
                     self.metrics.watchdog_trips += 1
+                    self._last_trip_time = time.perf_counter()
                     if self.tracer.enabled:
                         self.tracer.instant(
                             "watchdog_trip", cat="engine",

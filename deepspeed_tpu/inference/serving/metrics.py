@@ -14,10 +14,23 @@ wiring and ``ds_bench`` artifacts keep parsing; p99 keys are new.
 """
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from ...monitor.registry import Histogram, MetricsRegistry
+
+#: every terminal request gets exactly one SLO verdict (engine.py judges
+#: at the terminal transition; ``shed`` covers cancels/sheds/drains,
+#: ``failed`` covers engine-side failures — neither burns the latency SLO
+#: budget, both burn the availability story, so both count as "not good"
+#: in the burn rate)
+SLO_VERDICTS = ("good", "ttft_miss", "tpot_miss", "shed", "failed")
+
+#: terminal requests the rolling burn-rate gauge looks back over — long
+#: enough to smooth one bad batch, short enough that a recovered engine's
+#: gauge actually recovers
+SLO_WINDOW = 256
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -107,6 +120,20 @@ class ServingMetrics:
     mixed_mbu: Optional[float] = None
     #: packed tokens (decode + computed prefill) per second per chip
     mixed_tokens_per_sec_per_chip: Optional[float] = None
+    # -- SLO / goodput accounting (engine.py judges each request at its
+    # terminal transition against the ServingConfig SLO block) ----------
+    slo_good: int = 0
+    slo_ttft_miss: int = 0
+    slo_tpot_miss: int = 0
+    slo_shed: int = 0
+    slo_failed: int = 0
+    #: generated tokens of requests that MET their SLO — the numerator of
+    #: goodput (a replica can post a huge tokens/sec while every request
+    #: blows its latency budget; goodput cannot)
+    goodput_tokens: int = 0
+    #: goodput tokens inside the current throughput window (re-anchored
+    #: with it on traffic resume)
+    window_goodput_tokens: int = 0
     #: recompile-sentinel alarms: resident programs whose argument
     #: fingerprint changed (each one names the offender in the trace)
     recompiles: int = 0
@@ -130,6 +157,10 @@ class ServingMetrics:
             "ttft_s", lo=1e-5, hi=4e3)
         self.step_hist: Histogram = self.registry.histogram(
             "step_s", lo=1e-5, hi=4e3)
+        #: rolling SLO window: 1 per non-good terminal, 0 per good — the
+        #: burn-rate gauge is its mean (bounded memory, recovers as good
+        #: traffic pushes bad verdicts out)
+        self.slo_window: Deque[int] = deque(maxlen=SLO_WINDOW)
 
     def record_ttft(self, x: float) -> None:
         self.ttft_hist.observe(x)
@@ -137,9 +168,25 @@ class ServingMetrics:
     def record_step(self, x: float) -> None:
         self.step_hist.observe(x)
 
+    def note_slo(self, verdict: str, goodput_tokens: int = 0) -> None:
+        """Fold one terminal request's SLO verdict in: per-verdict
+        counters (field + ``slo_requests{verdict=}`` in the registry),
+        the rolling burn-rate window, and the goodput numerator."""
+        if verdict not in SLO_VERDICTS:
+            raise ValueError(f"unknown SLO verdict {verdict!r} "
+                             f"(want one of {SLO_VERDICTS})")
+        setattr(self, f"slo_{verdict}",
+                getattr(self, f"slo_{verdict}") + 1)
+        self.registry.counter("slo_requests", verdict=verdict).inc()
+        self.slo_window.append(0 if verdict == "good" else 1)
+        if goodput_tokens:
+            self.goodput_tokens += goodput_tokens
+            self.window_goodput_tokens += goodput_tokens
+
     def on_traffic_resume(self) -> None:
         self.window_start = time.perf_counter()
         self.window_tokens = 0
+        self.window_goodput_tokens = 0
 
     @property
     def occupancy(self) -> float:
@@ -165,6 +212,23 @@ class ServingMetrics:
         """Fraction of served prefill tokens that came from the cache."""
         return self.cached_prefill_tokens / self.prefill_tokens \
             if self.prefill_tokens else 0.0
+
+    @property
+    def goodput_tokens_per_sec(self) -> float:
+        """Generated-token throughput counting ONLY requests that met
+        their SLO (same window discipline as ``tokens_per_sec``): the
+        number a fleet's capacity planning should believe."""
+        dt = time.perf_counter() - self.window_start
+        return self.window_goodput_tokens / dt if dt > 0 else 0.0
+
+    @property
+    def slo_burn_rate(self) -> float:
+        """Fraction of the last ``SLO_WINDOW`` terminal requests that
+        did NOT meet their SLO (misses + sheds + failures). 0 with no
+        terminals yet — an idle replica is not burning budget."""
+        if not self.slo_window:
+            return 0.0
+        return sum(self.slo_window) / len(self.slo_window)
 
     def snapshot(self) -> Dict[str, float]:
         out = {
@@ -200,6 +264,14 @@ class ServingMetrics:
             "preemptions": float(self.preemptions),
             "steps": float(self.steps),
             "recompiles": float(self.recompiles),
+            "slo_good": float(self.slo_good),
+            "slo_ttft_miss": float(self.slo_ttft_miss),
+            "slo_tpot_miss": float(self.slo_tpot_miss),
+            "slo_shed": float(self.slo_shed),
+            "slo_failed": float(self.slo_failed),
+            "goodput_tokens": float(self.goodput_tokens),
+            "goodput_tokens_per_sec": self.goodput_tokens_per_sec,
+            "slo_burn_rate": self.slo_burn_rate,
         }
         for key in ("decode_flops_per_step", "decode_bytes_per_step",
                     "decode_mfu", "decode_mbu",

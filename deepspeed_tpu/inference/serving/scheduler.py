@@ -29,7 +29,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ...monitor.tracing import NULL_TRACER, Tracer
 from .block_pool import BlockPool, ChainKey
@@ -100,6 +100,10 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     finish_reason: Optional[str] = None
+    #: SLO verdict stamped at the terminal transition (engine.py judges;
+    #: one of metrics.SLO_VERDICTS) — rides the terminal "request" span
+    #: so trace_view can break SLO misses down by phase
+    slo_verdict: Optional[str] = None
     preemptions: int = 0
     admit_order: int = -1     # monotone stamp set at admission (victim pick)
     #: latest admission stamp (perf_counter seconds; None while queued)
@@ -178,6 +182,13 @@ class Scheduler:
         #: requests ``admit_next``/``expire_queued`` moved to TIMEOUT this
         #: step; the engine drains it for metrics/accounting
         self.reaped: List[Request] = []
+        #: called once per terminal transition, AFTER the request's final
+        #: state/reason/finish_time are set and BEFORE the terminal span
+        #: is emitted — the engine hangs SLO attribution here (setting
+        #: ``req.slo_verdict`` so the span carries it). Every terminal
+        #: path funnels through ``_release``, so the hook cannot miss a
+        #: request, including gate-side sheds the engine never touches.
+        self.on_terminal: Optional[Callable[[Request], None]] = None
 
     # -- tracing: phase transitions ------------------------------------
 
@@ -462,19 +473,33 @@ class Scheduler:
         req.state = state
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
+        if self.on_terminal is not None:
+            # SLO attribution (and any other terminal accounting) runs
+            # before the span below so the verdict rides it; a broken
+            # hook must not leak pages or wedge the release path — the
+            # pages are already back in the pool at this point
+            try:
+                self.on_terminal(req)
+            except Exception as e:
+                from ...utils.logging import logger
+
+                logger.error(f"scheduler on_terminal hook failed for "
+                             f"{req.rid}: {type(e).__name__}: {e}")
         # terminal: close the open phase and emit the request's umbrella
         # span (submit -> terminal) — the timeline-completeness contract:
         # EVERY terminal request has a request span whose phases tile it
         self._phase(req, "terminal", now=req.finish_time)
         if self.tracer.enabled:
-            self.tracer.complete(
-                "request", req.submit_time, req.finish_time, cat="request",
-                args={"rid": req.rid, "state": state.value, "reason": reason,
-                      "prompt_tokens": len(req.prompt),
-                      "generated": len(req.tokens),
-                      "preemptions": req.preemptions,
-                      "ttft_s": None if req.ttft is None
-                      else round(req.ttft, 6)})
+            args = {"rid": req.rid, "state": state.value, "reason": reason,
+                    "prompt_tokens": len(req.prompt),
+                    "generated": len(req.tokens),
+                    "preemptions": req.preemptions,
+                    "ttft_s": None if req.ttft is None
+                    else round(req.ttft, 6)}
+            if req.slo_verdict is not None:
+                args["slo"] = req.slo_verdict
+            self.tracer.complete("request", req.submit_time,
+                                 req.finish_time, cat="request", args=args)
 
     def finish(self, req: Request, reason: str) -> None:
         self._release(req, RequestState.FINISHED, reason)

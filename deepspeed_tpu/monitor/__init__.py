@@ -1,3 +1,5 @@
+from .export import (AdminServer, attach_serving_engine,  # noqa: F401
+                     live_admin_servers, render_prometheus, serve_admin)
 from .monitor import MonitorMaster, events_from_scalars  # noqa: F401
 from .perf import (CompiledProgram, PerfAccounting,  # noqa: F401
                    ProgramRegistry, device_memory_stats, device_peaks,

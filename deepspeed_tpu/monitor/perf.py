@@ -253,12 +253,16 @@ class ProgramRegistry:
 
     @property
     def recompile_total(self) -> int:
-        return sum(p.recompiles for p in self.programs.values())
+        # list() first: the admin server's /statusz thread reads this
+        # while the engine may be registering a program — a Python-level
+        # genexpr over a live values() view raises on concurrent insert
+        # (list(dict.values()) is GIL-atomic; the view iteration is not)
+        return sum(p.recompiles for p in list(self.programs.values()))
 
     def table(self) -> List[Dict[str, Any]]:
         rows = []
-        for name in sorted(self.programs):
-            row = self.programs[name].row()
+        for name, prog in sorted(self.programs.items()):
+            row = prog.row()
             if self.scope:
                 row["name"] = f"{self.scope}/{name}"
             rows.append(row)
@@ -587,5 +591,7 @@ class PerfAccounting:
             "hbm_bytes_in_use": live,
             "hbm_peak_bytes": peak,
             "programs": self.programs.table(),
-            "utilization": {k: dict(v) for k, v in self.last.items()},
+            # list() first — /statusz reads this off-thread while the
+            # engine publishes per-step utilization entries
+            "utilization": {k: dict(v) for k, v in list(self.last.items())},
         }

@@ -168,11 +168,16 @@ class MetricsRegistry:
         return h
 
     def items(self):
-        return self._metrics.items()
+        # a point-in-time LIST, not a live view: the admin server's
+        # /metrics renderer iterates from its own thread while the engine
+        # may be get-or-creating metrics — iterating a live dict view
+        # across an insert raises RuntimeError (list(dict.items()) is
+        # GIL-atomic; a live view is not)
+        return list(self._metrics.items())
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
-        for key, m in sorted(self._metrics.items()):
+        for key, m in sorted(self.items()):
             if isinstance(m, Histogram):
                 out[f"{key}_count"] = float(m.count)
                 if m.count:

@@ -197,6 +197,9 @@ class TracingConfig(DeepSpeedConfigModel):
     dir: Optional[str] = None
     #: trace events per flight-recorder dump
     flight_events: int = 512
+    #: also arm per-collective comm tracing (``comm/comm.py``:
+    #: ``comm:<op>`` spans + ``comm_op_s`` histograms) when tracing is on
+    comm: bool = True
 
 
 class AutotuningConfig(DeepSpeedConfigModel):

@@ -437,6 +437,16 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.tracer = Tracer(capacity=tcfg.capacity,
                              enabled=bool(tcfg.enabled or trace_dir))
         self.perf.programs.tracer = self.tracer
+        if self.tracer.enabled and tcfg.comm:
+            # per-collective observability (comm/comm.py): every
+            # all_reduce/all_gather/... staged by the train step emits a
+            # comm:<op> span + a comm_op_s{op,dtype,bytes_bucket}
+            # histogram — the per-op comm mix trace_view --summary and
+            # ds_report aggregate (process-global; last armed engine wins)
+            from ..comm.comm import configure_comm_tracing
+
+            configure_comm_tracing(tracer=self.tracer,
+                                   registry=self.registry)
         self.flight = None
         if trace_dir:
             self.flight = FlightRecorder(

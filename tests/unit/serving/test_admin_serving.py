@@ -1,0 +1,261 @@
+"""Control plane over a LIVE serving engine: endpoint liveness under
+duress (the healthz/readyz contract a router keys on), Prometheus
+/metrics validity, and SLO/goodput attribution pinned for every terminal
+class.
+
+The duress drills mirror the chaos suite: a watchdog trip must flip
+/healthz unhealthy WHILE /metrics keeps serving (the scrape is how the
+fleet learns about the incident — it must not die with the engine), and
+drain/brownout must flip /readyz NotReady and back."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+from deepspeed_tpu.inference.serving.metrics import SLO_VERDICTS
+from deepspeed_tpu.monitor.export import parse_prometheus, serve_admin
+from deepspeed_tpu.utils import fault_injection
+
+pytestmark = [pytest.mark.serving]
+
+
+def _get(url):
+    try:
+        r = urllib.request.urlopen(url, timeout=10)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ds.init_inference(model, params=params, dtype="fp32")
+    srv = ServingEngine(eng, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=32, max_model_len=64,
+        step_watchdog_s=0.4, trace=True))
+    return srv
+
+
+@pytest.fixture(scope="module")
+def admin(srv):
+    admin = serve_admin(srv, port=0)
+    yield admin
+    admin.close()
+
+
+def _drain(srv, max_steps=400):
+    steps = 0
+    while srv.has_work():
+        srv.step()
+        steps += 1
+        assert steps < max_steps, "engine wedged"
+
+
+def _run_one(srv, prompt=(3, 5, 7), new=3, **kw):
+    rid = srv.submit(list(prompt), max_new_tokens=new, **kw)
+    _drain(srv)
+    return rid
+
+
+def test_readyz_cold_then_warm(srv, admin):
+    """A cold replica (resident program not compiled) is NOT ready — a
+    router sending it traffic would eat the first compile as tail
+    latency. Warm = ready."""
+    code, body = _get(admin.url + "/readyz")
+    assert code == 503 and "cold" in json.loads(body)["reasons"]
+    _run_one(srv)  # pays the one resident compile
+    code, body = _get(admin.url + "/readyz")
+    assert code == 200 and json.loads(body)["resident_compiled"] is True
+
+
+def test_readyz_flips_under_drain_and_brownout(srv, admin):
+    srv.drain()
+    code, body = _get(admin.url + "/readyz")
+    assert code == 503 and "draining" in json.loads(body)["reasons"]
+    srv.resume_admission()
+    assert _get(admin.url + "/readyz")[0] == 200
+    srv.set_brownout(True)
+    code, body = _get(admin.url + "/readyz")
+    assert code == 503 and "brownout" in json.loads(body)["reasons"]
+    srv.set_brownout(None)
+    assert _get(admin.url + "/readyz")[0] == 200
+
+
+def test_metrics_is_valid_prometheus_and_matches_snapshot(srv, admin):
+    _run_one(srv)
+    code, text = _get(admin.url + "/metrics")
+    assert code == 200
+    series, types = parse_prometheus(text)
+    snap = srv.metrics.snapshot()
+    # gauges mirror the snapshot the moment of the scrape (counters only
+    # move when the engine steps — nothing stepped since the snapshot)
+    assert series[("ds_requests_submitted", frozenset())] == \
+        snap["requests_submitted"]
+    assert series[("ds_steps", frozenset())] == snap["steps"]
+    # the ONE-resident-compile invariant, readable off the wire
+    assert series[("ds_compile_count",
+                   frozenset({("program", "mixed_step")}))] == 1.0
+    # registry-backed families keep their kinds
+    assert types["ds_ttft_s"] == "summary"
+    assert ("ds_ttft_s", frozenset({("quantile", "0.5")})) in series
+    assert types["ds_slo_requests"] == "counter"
+    # goodput gauges ride the same scrape
+    assert ("ds_goodput_tokens_per_sec", frozenset()) in series
+    assert ("ds_slo_burn_rate", frozenset()) in series
+
+
+def test_healthz_flips_during_watchdog_trip_metrics_keeps_serving(
+        srv, admin, monkeypatch):
+    """THE duress drill: a wedged step trips the watchdog; while the
+    abandoned call is still stuck on the backend /healthz must answer
+    503 (route around me) while /metrics still answers 200 (tell the
+    fleet why)."""
+    assert _get(admin.url + "/healthz")[0] == 200
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "slow_step:seconds=1.2:fails=1")
+    fault_injection.reset()
+    rid = srv.submit([2, 4, 6], max_new_tokens=4)
+    try:
+        _drain(srv)  # trips at ~0.4s; the abandoned thread sleeps on
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert srv.poll(rid).finish_reason == "step_watchdog"
+    assert srv._wedged is not None and srv._wedged.is_alive()
+    code, body = _get(admin.url + "/healthz")
+    detail = json.loads(body)
+    assert code == 503 and detail["wedged"] is True
+    assert detail["last_watchdog_trip_age_s"] is not None
+    # the scrape must survive the incident it reports
+    code, text = _get(admin.url + "/metrics")
+    assert code == 200
+    series, _ = parse_prometheus(text)
+    assert series[("ds_watchdog_trips", frozenset())] >= 1.0
+    # wedge clears -> healthy again, traffic resumes
+    deadline = time.time() + 10
+    while srv._wedged is not None and srv._wedged.is_alive():
+        assert time.time() < deadline, "injected wedge never cleared"
+        time.sleep(0.05)
+    _run_one(srv)
+    assert _get(admin.url + "/healthz")[0] == 200
+
+
+def test_statusz_and_profilez_contract(srv, admin, tmp_path):
+    code, body = _get(admin.url + "/statusz")
+    assert code == 200
+    assert "mixed_step" in body and "compile_counts" in body
+    # no trace dir on this engine -> profiling disabled is a 501, not 500
+    assert _get(admin.url + "/profilez?seconds=1")[0] == 501
+
+
+# ---------------------------------------------------------------------------
+# SLO / goodput attribution — every terminal class pinned
+# ---------------------------------------------------------------------------
+
+def _verdicts(srv):
+    m = srv.metrics
+    return {v: getattr(m, f"slo_{v}") for v in SLO_VERDICTS}
+
+
+def test_slo_attribution_every_terminal_class(srv, monkeypatch):
+    """One engine, five verdicts: good (finish inside SLO), ttft_miss
+    (finish past a 0-second TTFT SLO, and a queued-timeout), tpot_miss
+    (finish past a 0-second TPOT SLO), shed (cancel), failed (logit
+    quarantine). The SLO knobs are runtime config — judged at the
+    terminal transition, so flipping them between requests is legal."""
+    srv.config.ttft_slo_s = None
+    srv.config.tpot_slo_s = None
+    before = _verdicts(srv)
+
+    # good: no SLO configured -> every finish is good
+    rid = _run_one(srv)
+    assert srv._requests[rid].slo_verdict == "good"
+    tokens_good = len(srv.poll(rid).tokens)
+    assert _verdicts(srv)["good"] == before["good"] + 1
+    assert srv.metrics.goodput_tokens >= tokens_good
+
+    # ttft_miss: an impossible TTFT budget
+    srv.config.ttft_slo_s = 0.0
+    rid = _run_one(srv)
+    assert srv._requests[rid].slo_verdict == "ttft_miss"
+    srv.config.ttft_slo_s = None
+
+    # tpot_miss: an impossible decode-rate budget (needs >1 token)
+    srv.config.tpot_slo_s = 0.0
+    rid = _run_one(srv, new=4)
+    assert srv._requests[rid].slo_verdict == "tpot_miss"
+    srv.config.tpot_slo_s = None
+
+    # ttft_miss via deadline: timed out BEFORE the first token
+    rid = srv.submit([1, 2, 3], max_new_tokens=4, deadline_s=0.0)
+    time.sleep(0.005)
+    _drain(srv)
+    assert srv.poll(rid).state == "timeout"
+    assert srv._requests[rid].slo_verdict == "ttft_miss"
+
+    # shed: caller cancel (same verdict as load shed / drain)
+    rid = srv.submit([1, 2, 3], max_new_tokens=4)
+    srv.cancel(rid)
+    assert srv._requests[rid].slo_verdict == "shed"
+    _drain(srv)
+
+    # failed: logit quarantine
+    monkeypatch.setenv(fault_injection.ENV_VAR, "corrupt_logits:fails=1")
+    fault_injection.reset()
+    rid = srv.submit([9, 8, 7], max_new_tokens=4)
+    try:
+        _drain(srv)
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert srv.poll(rid).state == "failed"
+    assert srv._requests[rid].slo_verdict == "failed"
+
+    after = _verdicts(srv)
+    for v in SLO_VERDICTS:
+        assert after[v] >= before[v] + 1, (v, before, after)
+    # burn rate: misses happened, so the window is burning but not empty
+    assert 0.0 < srv.metrics.slo_burn_rate < 1.0
+    snap = srv.metrics.snapshot()
+    for key in ("slo_good", "slo_ttft_miss", "slo_tpot_miss", "slo_shed",
+                "slo_failed", "goodput_tokens_per_sec", "slo_burn_rate"):
+        assert key in snap
+
+
+def test_slo_verdict_rides_terminal_request_span(srv):
+    """trace_view's phase breakdown keys misses by phase off the ``slo``
+    arg of the terminal request span — assert it lands in the trace."""
+    srv.config.ttft_slo_s = None
+    srv.config.tpot_slo_s = None
+    rid = _run_one(srv)
+    spans = [e for e in srv.tracer.events()
+             if e.get("name") == "request"
+             and (e.get("args") or {}).get("rid") == rid]
+    assert spans and spans[-1]["args"]["slo"] == "good"
+
+
+def test_trace_view_summary_aggregates_slo(srv, tmp_path):
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__)
+                           .resolve().parents[3] / "tools"))
+    import trace_view
+
+    path = srv.dump_trace(str(tmp_path / "t.json"))
+    s = trace_view.summarize([path])
+    assert s["slo_verdicts"].get("good", 0) >= 1
+    # mixed-step engine spans aggregate as before
+    assert "mixed_step" in s["engine_spans"]

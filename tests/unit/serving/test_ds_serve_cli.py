@@ -71,6 +71,94 @@ def test_demo_trace_dir_writes_perfetto_trace_and_stats(tmp_path):
     assert rids <= umbrellas
 
 
+def test_admin_port_live_process_answers_control_plane(tmp_path):
+    """The r11 acceptance path: a LIVE ``ds_serve --admin-port`` process
+    must answer /metrics (valid Prometheus text, parsed here), /healthz,
+    /readyz and /statusz while it serves. DS_FAULT=slow_step paces every
+    step so the serving window is long enough to probe without racing
+    the drain."""
+    import socket
+    import time
+    import urllib.error
+    import urllib.request
+
+    from deepspeed_tpu.monitor.export import parse_prometheus
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "12", "--cpu", "--admin-port", str(port),
+         "--ttft-slo-s", "60", "--tpot-slo-s", "60"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "DS_FAULT": "slow_step:seconds=0.05"})
+    url = f"http://127.0.0.1:{port}"
+
+    def get(path):
+        try:
+            r = urllib.request.urlopen(url + path, timeout=5)
+            return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    try:
+        # the server binds BEFORE the model loads: liveness within a few
+        # seconds of process start, long before any token is served
+        deadline = time.time() + 120
+        while True:
+            assert proc.poll() is None, \
+                (proc.poll(), proc.communicate()[1][-2000:])
+            try:
+                code, _ = get("/healthz")
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                assert time.time() < deadline, "admin server never bound"
+                time.sleep(0.1)
+        assert code == 200
+        # poll /metrics until the engine is attached AND serving (steps
+        # moving), all while the process lives
+        while True:
+            assert proc.poll() is None, \
+                (proc.poll(), proc.communicate()[1][-2000:])
+            code, text = get("/metrics")
+            assert code == 200
+            if text:
+                series, types = parse_prometheus(text)  # must be valid
+                if series.get(("ds_steps", frozenset()), 0) >= 1:
+                    break
+            assert time.time() < deadline, "engine never started serving"
+            time.sleep(0.1)
+        assert types["ds_ttft_s"] == "summary"
+        assert series[("ds_compile_count",
+                       frozenset({("program", "mixed_step")}))] == 1.0
+        code, body = get("/readyz")
+        assert code in (200, 503)  # cold until the first step compiles
+        assert json.loads(body)["ok"] is (code == 200)
+        code, body = get("/statusz")
+        assert code == 200 and "mixed_step" in body
+        out, err = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err[-2000:]
+    recs = [json.loads(ln) for ln in out.splitlines()
+            if ln.strip().startswith("{")]
+    final = recs[-1]
+    # the final report records the SLO block and the admin endpoint
+    assert final["slo"]["ttft_slo_s"] == 60.0
+    verdicts = final["slo"]["verdicts"]
+    assert sum(verdicts.values()) == 12 and verdicts["good"] == 12
+    assert final["slo"]["goodput_tokens"] > 0
+    assert final["admin"]["port"] == port
+    assert final["admin"]["scrapes"] >= 1
+    assert "goodput_tok/s=" not in out  # stats line stays on stderr
+
+
 def test_demo_cannot_mix_with_prompts(tmp_path):
     p = tmp_path / "p.jsonl"
     p.write_text('{"prompt_ids": [1]}\n')

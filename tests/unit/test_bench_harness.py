@@ -44,15 +44,22 @@ def test_bench_decode_tiny_emits_json():
 
 
 def test_bench_unreachable_backend_still_emits_json():
-    # a 1-second probe deadline cannot succeed against the tunneled backend;
-    # the parent must still exit 0 with a JSON record carrying an explicit
-    # error. The headline value is ALWAYS null on outage (it must reflect a
-    # measurement of this run's code); any resumable chip-window capture
+    # force the probe at a backend name that CANNOT exist on ANY host
+    # (jax rejects unknown platform names at init): the parent must still
+    # exit 0 with a JSON record carrying an explicit error. The headline
+    # value is ALWAYS null on outage (it must reflect a measurement of
+    # this run's code); any resumable chip-window capture
     # (BENCH_r*_local/_v2.json) rides along as detail.cached_value with
-    # provenance.
+    # provenance. NOT the tier-1 cpu value, and not "tpu" either (a real
+    # TPU VM would initialize it): under JAX_PLATFORMS=cpu a warm jax
+    # import occasionally beat the 1s probe deadline, bench.py then
+    # launched a REAL candidate subprocess, this test's timeout killed
+    # only the bench.py parent, and the candidate grandchild survived as
+    # a 400s 100%-CPU stray that poisoned every timing run after it.
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        env={**os.environ, "DS_BENCH_PROBE_S": "1"},
+        env={**os.environ, "DS_BENCH_PROBE_S": "5",
+             "JAX_PLATFORMS": "ds_bench_test_unreachable"},
         capture_output=True, text=True, timeout=120, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     rec = _last_json(r.stdout)

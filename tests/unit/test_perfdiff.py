@@ -110,6 +110,51 @@ def test_tracing_overhead_absolute_bar(tmp_path):
     assert run(tmp_path, BASE, cand) == 1
 
 
+def test_admin_overhead_absolute_bar(tmp_path):
+    # the r11 control-plane bar: a scraped /metrics server may cost the
+    # data plane < 1% median step — absolute, like the tracing bar
+    base = copy.deepcopy(BASE)
+    base["admin_overhead"] = {"admin_overhead_pct": -0.5}
+    cand = copy.deepcopy(base)
+    cand["admin_overhead"]["admin_overhead_pct"] = 0.8
+    assert run(tmp_path, base, cand) == 0
+    cand["admin_overhead"]["admin_overhead_pct"] = 1.4
+    assert run(tmp_path, base, cand) == 1
+
+
+def test_abs_bar_gates_candidate_only_metric(tmp_path):
+    # an absolute bar needs no baseline value: the generation that
+    # INTRODUCES the metric must already be gated, not hidden under
+    # "new in candidate" (the r10 -> r11 admin_overhead case)
+    cand = copy.deepcopy(BASE)
+    cand["admin_overhead"] = {"admin_overhead_pct": 4.0}
+    assert run(tmp_path, BASE, cand) == 1
+    cand["admin_overhead"]["admin_overhead_pct"] = 0.4
+    assert run(tmp_path, BASE, cand) == 0
+
+
+def test_abs_bar_dropped_from_candidate_is_a_regression(tmp_path):
+    # the symmetric hole: a candidate that stops MEASURING a barred
+    # metric (probe deleted/broken) must fail, not silently un-enforce
+    # the bar as an informational "dropped from candidate" line
+    base = copy.deepcopy(BASE)
+    base["admin_overhead"] = {"admin_overhead_pct": -0.5}
+    cand = copy.deepcopy(base)
+    del cand["admin_overhead"]
+    assert run(tmp_path, base, cand) == 1
+
+
+def test_last_dispatch_utilization_gauges_do_not_gate(tmp_path):
+    # perf.*_tokens_per_sec_per_chip (and the mfu/mbu per-call gauges)
+    # are instantaneous samples of whatever the LAST dispatch packed —
+    # informational, never a regression
+    base = copy.deepcopy(BASE)
+    base["perf"]["mixed_step_tokens_per_sec_per_chip"] = 8000.0
+    cand = copy.deepcopy(base)
+    cand["perf"]["mixed_step_tokens_per_sec_per_chip"] = 1900.0
+    assert run(tmp_path, base, cand) == 0
+
+
 def test_cross_device_refused_without_force(tmp_path, capsys):
     cand = copy.deepcopy(BASE)
     cand["meta"] = dict(META, device_kind="TPU v5 lite", platform="tpu")

@@ -54,8 +54,10 @@ NEVER_INCREASE = ("compile_counts.", "recompile")
 #: absolute bars, matched on the key's last component: the value itself
 #: must stay under the bar regardless of the baseline (the baseline may
 #: legitimately be negative — tracing overhead measured -2.2% — which a
-#: multiplicative band cannot handle)
-ABS_BARS = {"overhead_pct": 5.0}
+#: multiplicative band cannot handle). admin_overhead_pct is the r11
+#: control-plane bar: a scraped /metrics admin server may cost the data
+#: plane < 1% median step time.
+ABS_BARS = {"overhead_pct": 5.0, "admin_overhead_pct": 1.0}
 
 HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
                     "mfu", "mbu", "bandwidth", "gbps", "tflops",
@@ -64,11 +66,19 @@ HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
 LOWER_IS_BETTER = ("ttft", "latency", "wall", "overhead", "shed_rate",
                    "timeout_rate", "step_p", "evictions")
 
-#: meta/bookkeeping keys excluded from gating entirely
+#: meta/bookkeeping keys excluded from gating entirely. The perf block's
+#: per-CALL utilization gauges (tokens_per_sec_per_chip / mixed_step_mfu
+#: / mixed_step_mbu / decode_*) are instantaneous samples of whatever
+#: the LAST dispatch packed — a budget-full prefill step posts 10-40x a
+#: lone-decode step, so a run-to-run delta there is packing luck, not
+#: performance; the committed bars are the run aggregates
+#: (tokens_per_sec_compute_run, step_p50, ttft_*).
 SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         "prefill_chunk_tokens", "served_tokens", "tokens_generated",
         "counters.", "by_state.", "offered", "queue_depth_cap", "deadline_s",
-        "perf.peak_", "perf.n_devices", "hbm_")
+        "perf.peak_", "perf.n_devices", "hbm_", "tokens_per_sec_per_chip",
+        "perf.mixed_step_mfu", "perf.mixed_step_mbu", "perf.decode_mfu",
+        "perf.decode_mbu")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
@@ -206,24 +216,41 @@ def main(argv=None) -> int:
     shared = sorted(set(fb) & set(fc))
     regressions: List[str] = []
     rows: List[str] = []
-    for key in shared:
+    n_gated = 0
+    for key in sorted(set(fb) | set(fc)):
         kind = classify(key)
+        if kind == "abs_bar":
+            # absolute bars need no baseline value, so they gate even on
+            # the generation that INTRODUCES the metric (a candidate-only
+            # admin_overhead_pct of 5 must fail, not hide under "new in
+            # candidate") — and a candidate that DROPS a barred metric
+            # fails too: deleting the probe must not un-enforce the bar
+            bar = ABS_BARS[key.rsplit(".", 1)[-1]]
+            n_gated += 1
+            if key not in fc:
+                rows.append(f"  {'REGRESSED':<10} {key}: {fb[key]:g} -> "
+                            f"MISSING (absolute bar <= {bar:g} must keep "
+                            f"being measured)")
+                regressions.append(key)
+                continue
+            bad = fc[key] > bar
+            base_txt = f"{fb[key]:g}" if key in fb else "(new)"
+            rows.append(f"  {'REGRESSED' if bad else 'ok':<10} {key}: "
+                        f"{base_txt} -> {fc[key]:g} (absolute bar "
+                        f"<= {bar:g})")
+            if bad:
+                regressions.append(key)
+            continue
+        if key not in fb or key not in fc:
+            continue  # banded rules need both sides; listed below
         if kind is None:
             if args.verbose:
                 rows.append(f"  {'info':<10} {key}: {fb[key]:g} -> "
                             f"{fc[key]:g}")
             continue
-        if kind == "abs_bar":
-            bar = ABS_BARS[key.rsplit(".", 1)[-1]]
-            bad = fc[key] > bar
-            rows.append(f"  {'REGRESSED' if bad else 'ok':<10} {key}: "
-                        f"{fb[key]:g} -> {fc[key]:g} (absolute bar "
-                        f"<= {bar:g})")
-            if bad:
-                regressions.append(key)
-            continue
         tol = tols.get(key, 0.0 if kind == "never_increase"
                        else args.default_tol)
+        n_gated += 1
         bad, pct = judge(kind, fb[key], fc[key], tol)
         status = "REGRESSED" if bad else "ok"
         rows.append(f"  {status:<10} {key}: {fb[key]:g} -> {fc[key]:g} "
@@ -235,7 +262,8 @@ def main(argv=None) -> int:
     print(f"perfdiff: {base_path} -> {cand_path} "
           f"[{bm.get('device_kind', 'unknown device')}"
           f" x{bm.get('device_count', '?')}]: "
-          f"{len(shared)} shared metrics")
+          f"{len(shared)} shared metrics, {n_gated} gated (abs bars gate "
+          f"one-sided keys too)")
     for r in rows:
         print(r)
     only_base = sorted(set(fb) - set(fc))

@@ -27,9 +27,12 @@ whole-incident view a directory of dumps wants: per-program engine time
 share (the unified ``mixed_step``, or the old ``decode_step`` /
 ``prefill_chunk`` pair — spans aggregate by NAME, so r8/r9-era dumps and
 unified-engine dumps both parse, even mixed in one ``--summary`` call),
-per-request phase totals, XLA compile counts by kind, every
-recompile-sentinel event with the argument it named, and the worst-N
-requests by TTFT with the file each came from:
+the per-collective comm mix (``comm:<op>`` spans from
+``comm.configure_comm_tracing`` — count, span time share, bytes per op),
+per-request phase totals with SLO verdict counts (the ``slo`` arg the
+serving engine stamps on terminal request spans), XLA compile counts by
+kind, every recompile-sentinel event with the argument it named, and the
+worst-N requests by TTFT with the file each came from:
 
   python tools/trace_view.py --summary /tmp/traces/*.json*
   python tools/trace_view.py --summary --worst 10 --json dir/*.jsonl
@@ -111,7 +114,8 @@ def request_breakdown(events: List[Dict[str, Any]]
         if rid not in out:
             out[rid] = {f"{p}_s": 0.0 for p in PHASES}
             out[rid].update(total_s=None, ttft_s=None, state=None,
-                            reason=None, preemptions=0, complete=False)
+                            reason=None, slo=None, preemptions=0,
+                            complete=False)
         return out[rid]
 
     for ev in events:
@@ -130,6 +134,7 @@ def request_breakdown(events: List[Dict[str, Any]]
             r["ttft_s"] = args.get("ttft_s")
             r["state"] = args.get("state")
             r["reason"] = args.get("reason")
+            r["slo"] = args.get("slo")
             r["preemptions"] = args.get("preemptions", 0)
             r["complete"] = True
     return out
@@ -149,9 +154,11 @@ def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
     total_events = 0
     flights: List[Dict[str, Any]] = []
     engine_spans: Dict[str, List[float]] = {}   # name -> [count, total_us]
+    comm_spans: Dict[str, List[float]] = {}     # op -> [count, us, bytes]
     compiles: Dict[str, int] = {}
     recompiles: List[Dict[str, Any]] = []
     phase_totals = {p: 0.0 for p in PHASES}
+    slo_verdicts: Dict[str, int] = {}
     requests: List[Dict[str, Any]] = []
     for path in paths:
         events, header = load_events(path)  # ValueError on bad structure
@@ -169,6 +176,14 @@ def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
                 c = engine_spans.setdefault(name, [0, 0.0])
                 c[0] += 1
                 c[1] += ev.get("dur", 0.0)
+            elif ev.get("ph") == "X" and ev.get("cat") == "comm":
+                # per-collective spans (comm/comm.py): op name after the
+                # "comm:" prefix; args carry the payload bytes
+                op = name.split(":", 1)[1] if ":" in name else name
+                c = comm_spans.setdefault(op, [0, 0.0, 0.0])
+                c[0] += 1
+                c[1] += ev.get("dur", 0.0)
+                c[2] += (ev.get("args") or {}).get("bytes", 0)
             elif name == "xla_compile":
                 kind = (ev.get("args") or {}).get("kind", "?")
                 compiles[kind] = compiles.get(kind, 0) + 1
@@ -180,6 +195,8 @@ def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
                              **rec})
             for p in PHASES:
                 phase_totals[p] += rec[f"{p}_s"]
+            if rec.get("slo"):
+                slo_verdicts[rec["slo"]] = slo_verdicts.get(rec["slo"], 0) + 1
     # the engine-program share excludes envelope spans ("step" wraps the
     # whole mixed step; "train_batch" wraps train_step + data_fetch)
     envelopes = {"step", "train_batch"}
@@ -187,6 +204,7 @@ def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
     share_base = sum(c[1] for c in prog_us.values())
     worst_reqs = sorted((r for r in requests if r.get("ttft_s") is not None),
                         key=lambda r: -r["ttft_s"])[:worst]
+    comm_base = sum(c[1] for c in comm_spans.values())
     return {
         "files": len(paths),
         "events": total_events,
@@ -196,10 +214,18 @@ def summarize(paths: List[str], worst: int = 5) -> Dict[str, Any]:
                 "share": (c[1] / share_base) if share_base and
                          n not in envelopes else None}
             for n, c in sorted(engine_spans.items())},
+        # per-collective comm mix (comm/comm.py spans): share is of COMM
+        # span time — which ops dominate the staged communication
+        "comm_spans": {
+            op: {"count": int(c[0]), "total_s": c[1] / 1e6,
+                 "bytes": int(c[2]),
+                 "share": (c[1] / comm_base) if comm_base else None}
+            for op, c in sorted(comm_spans.items())},
         "xla_compiles": compiles,
         "recompiles": recompiles,
         "requests": len(requests),
         "request_phase_totals_s": phase_totals,
+        "slo_verdicts": slo_verdicts,
         "worst_ttft": worst_reqs,
     }
 
@@ -217,6 +243,12 @@ def _print_summary(s: Dict[str, Any]) -> None:
                 else f"{100.0 * rec['share']:4.0f}%"
             print(f"  {n:<18}{rec['count']:>7} x  {rec['total_s']:9.4f}s"
                   f"  {share}")
+    if s["comm_spans"]:
+        print("per-collective comm (share of comm span time):")
+        for op, rec in s["comm_spans"].items():
+            print(f"  {op:<18}{rec['count']:>7} x  {rec['total_s']:9.4f}s"
+                  f"  {100.0 * (rec['share'] or 0):4.0f}%"
+                  f"  {rec['bytes']:>12} B")
     if s["xla_compiles"]:
         print("xla compiles: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s["xla_compiles"].items())))
@@ -232,6 +264,9 @@ def _print_summary(s: Dict[str, Any]) -> None:
     print("request phase totals: " + ", ".join(
         f"{p}={pt[p]:.4f}s ({_share(pt[p], whole).strip()})"
         for p in PHASES))
+    if s["slo_verdicts"]:
+        print("slo verdicts: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s["slo_verdicts"].items())))
     if s["worst_ttft"]:
         print(f"worst {len(s['worst_ttft'])} requests by TTFT:")
         for r in s["worst_ttft"]:
@@ -311,7 +346,8 @@ def main(argv=None) -> int:
               f"{_share(r['prefill_s'], ttft):>9}"
               f"{r['decode_s']:>10.4f}"
               f"{'n/a' if r['total_s'] is None else format(r['total_s'], '9.4f'):>9}"
-              f"  {r['reason'] or ''}{note}")
+              f"  {r['reason'] or ''}"
+              f"{' slo=' + r['slo'] if r.get('slo') else ''}{note}")
     return 0
 
 
