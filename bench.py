@@ -48,6 +48,55 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def stray_bench_processes():
+    """PIDs (with cmdlines) of OTHER live bench.py processes on this box.
+
+    The PR 8 de-flake post-mortem: a test's timeout killed a bench.py
+    parent but its candidate grandchild survived as a ~400s 100%-CPU
+    stray that silently poisoned every later timing run on this 1-core
+    machine. Numbers taken next to such a stray are not noisy — they are
+    wrong — so the pre-flight ABORTS with the named PID instead of
+    measuring. Own process and direct ancestors are excluded (pytest
+    drives bench.py as a child; the chain above us is not contention)."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    while pid > 1:
+        ancestors.add(pid)
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split(")")[-1].split()[1])  # ppid
+        except (OSError, ValueError, IndexError):
+            break
+    out = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return out  # no procfs (not linux): the guard degrades to off
+    for entry in entries:
+        if not entry.isdigit() or int(entry) in ancestors:
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                argv = [a for a in
+                        f.read().decode("utf-8", "replace").split("\0") if a]
+        except OSError:
+            continue  # raced a process exit
+        if not argv:
+            continue
+        # only processes EXECUTING bench.py count: argv0 is bench.py
+        # itself, or a python interpreter whose script arg is bench.py.
+        # An editor or pager with bench.py on its command line ('vim
+        # bench.py') is idle, not contention
+        exe = os.path.basename(argv[0])
+        running_it = exe == "bench.py" or (
+            exe.startswith("python")
+            and any(os.path.basename(a) == "bench.py" for a in argv[1:3]))
+        if running_it:
+            out.append((int(entry), " ".join(argv)))
+    return out
+
+
 def model_flops_per_step(n_params: int, batch: int, seq: int, n_layer: int,
                          hidden: int) -> float:
     """fwd+bwd FLOPs: 6*N*tokens + attention 12*L*B*T^2*H (PaLM appendix B)."""
@@ -242,6 +291,22 @@ def main():
     cand_cap = float(os.environ.get("DS_BENCH_CANDIDATE_S",
                                     "170" if tiny else "420"))
     t_start = time.time()
+
+    # 0) stray-process pre-flight: refuse to time anything while another
+    # bench.py (or a leaked candidate child of one) is alive — on this
+    # box that stray owns the core and every number would be quietly
+    # contended. DS_BENCH_IGNORE_STRAYS=1 overrides for deliberate
+    # side-by-side runs.
+    if not os.environ.get("DS_BENCH_IGNORE_STRAYS"):
+        strays = stray_bench_processes()
+        if strays:
+            pid, cmd = strays[0]
+            log(f"bench: ABORT — stray bench process pid={pid} is alive "
+                f"({cmd[:120]}); kill it (or set DS_BENCH_IGNORE_STRAYS=1) "
+                f"before timing")
+            emit(None, None,
+                 error=f"stray bench process pid={pid} alive: {cmd[:200]}")
+            return
 
     # 1) fail-fast device probe (skipped in tiny/CPU smoke mode)
     if not tiny:

@@ -215,6 +215,29 @@ def perf_report() -> None:
               f"  {flops} ({r['cost_source'] or '-'})")
 
 
+def speculation_report() -> None:
+    """Speculative-decoding status of every live ServingEngine in this
+    process (drafter kind, draft cap, rolling accept rate) — printed
+    next to the compiled-program table, which is per-process for the
+    same reason: a fresh ``ds_report`` CLI run has no engines; call from
+    inside a serving process (or a test) to see them."""
+    from deepspeed_tpu.inference.serving import live_serving_engines
+
+    engines = live_serving_engines()
+    if not engines:
+        return  # nothing to report; stay silent like the program table
+    for srv in engines:
+        st = srv.speculation_status()
+        if not st["enabled"]:
+            print("speculation: off (ServingConfig.spec_tokens=0)")
+            continue
+        print(f"speculation: {st['drafter']} k<={st['spec_tokens']} — "
+              f"drafted {st['drafted']}, accepted {st['accepted']} "
+              f"(accept rate {st['accept_rate']:.2f}, "
+              f"{st['tokens_per_verify']:.2f} tok/verify-row, "
+              f"{st['pages_dropped']} pages rolled back)")
+
+
 def checkpoint_report(ckpt_dir: str) -> int:
     """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
     every save's manifest in a checkpoint dir, print the last-good tag.
@@ -283,6 +306,7 @@ def main(argv=None):
     trace_report()
     admin_report()
     perf_report()
+    speculation_report()
     comm_report()
     op_report()
     return 0

@@ -72,13 +72,14 @@ import dataclasses
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+import weakref
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.layers import paged_cache_index
+from ...models.layers import harvest_packed_logits, paged_cache_index
 from ...monitor.perf import (PerfAccounting, estimate_decode_step_bytes,
                              estimate_decode_step_flops, param_bytes,
                              transformer_flops_per_token)
@@ -93,6 +94,17 @@ from .scheduler import RejectedError, Request, RequestState, Scheduler
 
 class StepWatchdogTimeout(RuntimeError):
     """A resident serving step exceeded ``step_watchdog_s`` wall-clock."""
+
+
+#: live engines in this process (weak — a dropped engine vanishes);
+#: ``ds_report`` reads speculation status from here, next to the
+#: compiled-program table that is per-process for the same reason
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_serving_engines() -> List["ServingEngine"]:
+    """Strong refs to every live ServingEngine in this process."""
+    return list(_LIVE_ENGINES)
 
 
 @dataclasses.dataclass
@@ -148,6 +160,40 @@ class ServingConfig:
     #: ``mixed_step`` it also sizes the packed token batch
     #: (``max_batch_size - 1 + budget``). 0 = one chunk's worth per step.
     prefill_token_budget: int = 0
+    # -- speculative decoding (serving/speculative.py) ------------------
+    #: max drafted tokens per resident per step (0 = speculation off).
+    #: A speculating resident packs a VERIFY row (``query_len = k + 1``)
+    #: instead of its T=1 decode row — same resident program, same one
+    #: dispatch — and commits up to ``k + 1`` tokens when the target
+    #: model's greedy predictions confirm the drafts. Verify rows spend
+    #: the packed step's LEFTOVER capacity only: prefill grants and the
+    #: one guaranteed decode token per resident always outrank them, so
+    #: speculation degrades to plain decode under prefill pressure
+    #: instead of starving admissions. Requires the unified
+    #: ``mixed_step`` engine and greedy sampling (``do_sample=False`` —
+    #: the accept rule compares greedy argmax predictions).
+    spec_tokens: int = 0
+    #: longest n-gram the default prompt-lookup drafter matches against
+    #: the resident's own prompt + generated history (it falls back to
+    #: shorter n-grams down to 1; no match = no draft = plain decode)
+    spec_ngram: int = 3
+    #: pluggable drafter (``serving.speculative.Drafter``); None with
+    #: ``spec_tokens > 0`` builds the model-free
+    #: :class:`~.speculative.PromptLookupDrafter` — a small draft model
+    #: can implement the same interface later. The engine never mutates
+    #: it, so one instance may serve several engines.
+    drafter: Optional[Any] = None
+    #: opt-in pow2-bucketed packed widths for the mixed step: instead of
+    #: every step paying the full ``[1, max_batch_size - 1 + budget]``
+    #: padded token batch (decode-only steps on the XLA reference path
+    #: compute mostly padding), the engine compiles a small bounded set
+    #: of widths (pow2 steps from ``max_batch_size`` up to the full
+    #: capacity) and dispatches the narrowest bucket that fits the
+    #: step's packed rows. ``compile_counts["mixed_step"]`` is then
+    #: bounded by the bucket count (instead of exactly 1) and the
+    #: recompile sentinel learns one fingerprint per bucket. Default off:
+    #: the strict one-compile invariant stays the default contract.
+    mixed_step_buckets: bool = False
     #: write serving counters to the monitor every N steps (0 = never)
     monitor_every: int = 1
     # -- overload control / resilience ---------------------------------
@@ -255,6 +301,52 @@ class ServingEngine:
         self._mixed_tokens = max(cfg.max_batch_size,
                                  cfg.max_batch_size - 1 + self._chunk_budget)
 
+        # -- speculative decoding: drafter + verify-row bookkeeping -----
+        if cfg.spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0 (0 = off)")
+        self._drafter = None
+        if cfg.spec_tokens > 0:
+            if not self._mixed:
+                raise ValueError(
+                    "speculative decoding needs the unified mixed step "
+                    "(mixed_step=True): verify rows are packed ragged "
+                    "segments of the one resident program")
+            if cfg.do_sample:
+                raise ValueError(
+                    "speculative decoding requires greedy sampling "
+                    "(do_sample=False): the accept rule compares the "
+                    "target model's argmax predictions against the "
+                    "drafts token for token")
+            if cfg.drafter is not None:
+                self._drafter = cfg.drafter
+            else:
+                from .speculative import PromptLookupDrafter
+
+                self._drafter = PromptLookupDrafter(cfg.spec_ngram)
+
+        # -- bucketed packed widths (opt-in; see mixed_step_buckets) ----
+        self._bucket_widths: Optional[List[int]] = None
+        if cfg.mixed_step_buckets:
+            if not self._mixed:
+                raise ValueError("mixed_step_buckets needs mixed_step=True")
+            ws: List[int] = []
+            w = next_pow2(max(1, cfg.max_batch_size))
+            while w < self._mixed_tokens:
+                ws.append(w)
+                w *= 2
+            ws.append(self._mixed_tokens)
+            self._bucket_widths = ws
+        # the adaptive draft cap trades draft length for a NARROWER
+        # dispatch, so it only engages where width actually costs:
+        # bucketed packed widths (narrower bucket = less padded compute)
+        # or the Pallas kernel (per live q-tile). On the fixed-width
+        # XLA reference path a rejected draft occupies padding the step
+        # computes either way — shrinking there would only suppress
+        # commits. The packed-capacity slack bound applies everywhere.
+        mcfg = getattr(engine.module, "config", None)
+        self._spec_adaptive = self._bucket_widths is not None or \
+            getattr(mcfg, "decode_attention_impl", None) == "pallas"
+
         # tracing first: scheduler and pool take the tracer at construction
         # (NULL-like when disabled — emission sites cost one bool check)
         self.tracer = Tracer(capacity=cfg.trace_capacity,
@@ -320,8 +412,12 @@ class ServingEngine:
         self.compile_counts = {"mixed_step": 0} if self._mixed else \
             {"decode": 0, "prefill": 0, "chunked_prefill": 0}
         #: first mixed/decode/chunked-prefill call carries the XLA compile
-        #: and is never watchdog-judged (heartbeat.py's first-beat rule)
+        #: and is never watchdog-judged (heartbeat.py's first-beat rule).
+        #: With bucketed widths each bucket's first call carries its OWN
+        #: compile, so warmth is tracked per width (``_warm_widths``);
+        #: ``_mixed_warm`` stays the readiness bit (ever dispatched).
         self._mixed_warm = False
+        self._warm_widths: "set[int]" = set()
         self._decode_warm = False
         self._chunked_warm = False
         #: the one abandoned watchdog thread, if still wedged in device
@@ -331,7 +427,9 @@ class ServingEngine:
         #: None = never happened)
         self._last_trip_time: Optional[float] = None
         self._last_quarantine_time: Optional[float] = None
-        self._mixed_fn = None
+        #: resident mixed-step executables keyed by packed width (one
+        #: entry — the full capacity — unless mixed_step_buckets)
+        self._mixed_fns: Dict[int, Any] = {}
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
         self._chunked_prefill_fn = None
@@ -344,9 +442,12 @@ class ServingEngine:
         # updates; the price is one pool copy per step.
         self._donate = (1,) if jax.default_backend() != "cpu" \
             and not cfg.step_watchdog_s else ()
+        _LIVE_ENGINES.add(self)
         log_dist(f"ServingEngine: slots={B}, pool={cfg.num_blocks}x"
                  f"{cfg.block_size} ({kv_dtype.__name__ if hasattr(kv_dtype, '__name__') else kv_dtype}), "
-                 f"max_len={cfg.max_model_len}", ranks=[0])
+                 f"max_len={cfg.max_model_len}"
+                 + (f", spec={self._drafter.kind} k<={cfg.spec_tokens}"
+                    if self._drafter is not None else ""), ranks=[0])
 
     # ------------------------------------------------------------------
     # public API
@@ -975,13 +1076,29 @@ class ServingEngine:
     # the unified mixed step (ONE resident program per step)
     # ------------------------------------------------------------------
 
-    def _grow_decode_pages(self) -> None:
-        """Guarantee every decoding resident a page for the token this
-        step appends, preempting (lowest priority, newest first) when the
-        pool runs dry; shared append targets are copied-on-write."""
+    def _grow_decode_pages(self, spec_plan: Optional[Dict[str, List[int]]]
+                           = None) -> None:
+        """Guarantee every decoding resident pages for the tokens this
+        step appends — one for a plain decode row, ``1 + k`` positions
+        for a verify row carrying ``k`` drafts — preempting (lowest
+        priority, newest first) when the pool runs dry; shared append
+        targets are copied-on-write. Draft pages degrade FIRST: when the
+        pool cannot grow a resident's speculative lookahead, its drafts
+        are dropped (plain decode this step) before anyone is evicted —
+        speculation must never convert verify appetite into
+        preemptions."""
+        bs = self.block_pool.block_size
         for _, req in list(self.sched.active()):
             if req.state is not RequestState.RUNNING or req.prefilling:
                 continue  # preempted below while growing an earlier slot
+            k = len(spec_plan.get(req.rid, ())) if spec_plan else 0
+            if k and not self.sched.ensure_decode_headroom(req, lookahead=k):
+                spec_plan.pop(req.rid, None)
+                k = 0
+                # pages the partial lookahead growth may have allocated
+                # are returned right away (the rollback helper keeps
+                # exactly the next append's page)
+                self._drop_trailing_pages(req)
             while not self.sched.ensure_decode_headroom(req):
                 victim = self.sched.preempt_victim(exclude=req)
                 if victim is None:
@@ -994,28 +1111,178 @@ class ServingEngine:
                     break
                 self._preempt(victim)
             else:
-                # this step appends at seq_len: never into a page other
-                # sequences still reference — copy-on-write first
-                self._ensure_exclusive(req, req.seq_len // self.block_pool.
-                                       block_size)
+                # this step appends at seq_len .. seq_len + k: never into
+                # a page other sequences still reference — copy-on-write
+                # every spanned page first
+                for idx in range(req.seq_len // bs,
+                                 (req.seq_len + k) // bs + 1):
+                    self._ensure_exclusive(req, idx)
                 self._write_table_row(req)  # growth may have added a page
                 continue
             break
 
+    def _plan_speculation(self, grants: Dict[str, int]
+                          ) -> Dict[str, List[int]]:
+        """Draft tokens per decoding resident (``{rid: drafts}``) for
+        this step's verify rows, sized to the packed step's LEFTOVER
+        capacity: every decode row's guaranteed token and every prefill
+        grant are reserved first, so speculation degrades to k=0 plain
+        decode under prefill pressure instead of starving admissions.
+        The per-request adaptive cap (``req.spec_k``: grown on full
+        accepts, halved on full rejects) keeps adversarial traffic from
+        paying verify tokens for drafts that never land; a drafter with
+        nothing to propose skips the row entirely."""
+        if self._drafter is None:
+            return {}
+        cfg = self.config
+        decoders = [r for _, r in self.sched.active()
+                    if r.state is RequestState.RUNNING and not r.prefilling]
+        plan: Dict[str, List[int]] = {}
+        if not decoders:
+            return plan
+        slack = self._mixed_tokens - len(decoders) - sum(grants.values())
+        for req in decoders:  # slot-ascending (the packing order)
+            if slack <= 0:
+                break
+            if req.spec_k < 0:
+                req.spec_k = cfg.spec_tokens
+            # a verify row may commit up to k + 1 tokens and appends KV
+            # through position seq_len + k: cap by the remaining token
+            # budget and the sequence length cap as well as the packed
+            # slack and — where dispatch width costs (see __init__) —
+            # the adaptive per-request cap
+            cap = req.spec_k if self._spec_adaptive else cfg.spec_tokens
+            k = min(cap, slack, req.remaining_new - 1,
+                    cfg.max_model_len - 1 - req.seq_len)
+            if k <= 0:
+                continue
+            drafts = self._drafter.draft(req.resume_tokens, k)
+            if not drafts:
+                continue
+            drafts = [int(t) for t in drafts[:k]]
+            plan[req.rid] = drafts
+            slack -= len(drafts)
+        return plan
+
+    def _drop_trailing_pages(self, req: Request) -> int:
+        """Free every pool page past the one the NEXT append targets —
+        the page-drop half of speculative rollback. Pages holding only
+        rejected draft KV were never content-indexed (hashes commit from
+        the ACCEPTED ``seq_len`` watermark only), so freeing them blanks
+        them; the partially-rejected page at ``seq_len // bs`` is kept
+        and simply overwritten by the next append."""
+        keep = req.seq_len // self.block_pool.block_size + 1
+        if len(req.blocks) <= keep:
+            return 0
+        drop = req.blocks[keep:]
+        del req.blocks[keep:]
+        self.block_pool.free(drop, req.rid)
+        self._write_table_row(req)
+        self.metrics.spec_pages_dropped += len(drop)
+        return len(drop)
+
+    def _commit_verify_row(self, slot: int, req: Request,
+                           drafts: List[int], preds: List[int]) -> int:
+        """Greedy accept-prefix over one verify row: ``preds[j]`` is the
+        target model's prediction AFTER the row's j-th packed token, so
+        draft ``j`` is accepted iff every earlier draft was and
+        ``preds[j] == drafts[j]``. Commits the accepted drafts plus the
+        model's own bonus token, rewinds ``seq_len`` past exactly the
+        accepted KV (rejected appends beyond it become invisible and are
+        overwritten later), drops whole rejected pages, and adapts the
+        request's draft cap. Returns the number of committed tokens."""
+        k = len(drafts)
+        a = 0
+        while a < k and drafts[a] == preds[a]:
+            a += 1
+        commit = drafts[:a] + [preds[a]]
+        # an accepted EOS ends the stream exactly where the plain engine
+        # would have stopped generating — nothing after it commits
+        if req.eos_token_id is not None and req.eos_token_id in commit:
+            commit = commit[:commit.index(req.eos_token_id) + 1]
+        commit = commit[:req.remaining_new]
+        m = self.metrics
+        m.spec_drafted += k
+        m.spec_accepted += a
+        m.spec_committed += len(commit)
+        m.spec_verify_rows += 1
+        # decay-then-add: the request-local counters track the RECENT
+        # accept rate (horizon of a few verifies), not lifetime — the
+        # gate below must release as soon as the stream turns
+        # predictable, not after new accepts outvote an old cold streak
+        req.spec_drafted = req.spec_drafted * 0.75 + k
+        req.spec_accepted = req.spec_accepted * 0.75 + a
+        # adaptive cap (AIMD on the observed accept length): a
+        # fully-confirmed draft DOUBLES the cap — a stream that just
+        # turned predictable (the post-divergence loop regime) must not
+        # crawl back one token per step — while any miss shrinks the cap
+        # to just past what actually landed (floor 1 so the request
+        # keeps probing and can recover). Without the shrink, a stream
+        # accepting 2 of 12 every step would pay 13-token verify rows
+        # forever to commit 3 — the adversarial overhead this cap exists
+        # to bound
+        if a == k:
+            req.spec_k = min(self.config.spec_tokens, max(req.spec_k * 2, 2))
+        else:
+            req.spec_k = max(1, min(req.spec_k, a + 1))
+        # chronic-miss gate on top of the per-step AIMD: a request whose
+        # RECENT accept rate (the decayed counters above) stays under
+        # 1/3 — judged only once enough recent drafts exist — is clamped
+        # to a 1-token probe. The AIMD alone oscillates on streams that
+        # loop briefly then break (grow on the loop, collapse on the
+        # break), paying wide verify rows for ~nothing; the probe keeps
+        # the request cheap AND keeps sampling, and a few accepted
+        # probes dominate the decayed window, so the gate releases
+        # within steps of the stream turning predictable
+        if req.spec_drafted >= 8 and \
+                req.spec_accepted * 3 < req.spec_drafted:
+            req.spec_k = 1
+        # KV bookkeeping: the row appended positions seq_len .. seq_len+k
+        # (the last committed token's own KV is in the pool only when the
+        # commit ends on a draft; a commit ending on the bonus token
+        # leaves it to the next step's append — both land on
+        # seq_len = len(resume_tokens) - 1, the plain-decode invariant)
+        req.seq_len += len(commit)
+        self._seq_lens[slot] = req.seq_len
+        self._drop_trailing_pages(req)
+        # every committed token flows through the ONE harvest path (eos /
+        # length finish, TTFT, stream, metrics). EOS and the length cap
+        # can only trigger on the LAST committed token by construction
+        # (the truncations above), so the hash commit between the two
+        # harvest phases always runs on a live, page-owning request
+        for t in commit[:-1]:
+            self._harvest(req, t)
+        self._commit_full_blocks(req)
+        self._harvest(req, commit[-1])
+        return len(commit)
+
     def _step_mixed(self, t0: float, brownout: bool) -> None:
         """The device half of the unified step: pack one decode token per
-        running resident plus this step's budgeted prefill chunks into a
-        single ragged token batch, dispatch the ONE resident program, and
-        harvest per row. Raggedness — segment offsets/lengths, chunk
-        starts, context lengths, block tables — rides as DATA, so any
-        traffic mix reuses one compile and one dispatch."""
+        running resident (``k + 1`` for a speculating one — its drafts
+        ride the same row as a prefill-like verify segment) plus this
+        step's budgeted prefill chunks into a single ragged token batch,
+        dispatch the ONE resident program, and harvest per row.
+        Raggedness — segment offsets/lengths, chunk starts, context
+        lengths, block tables — rides as DATA, so any traffic mix reuses
+        one compile and one dispatch."""
         cfg = self.config
-        self._grow_decode_pages()
 
         # prefill grants: round-robin chunk-sized shares of the step's
         # token budget across mid-prefill residents (admission order);
         # grants to one request are contiguous, so several rounds simply
         # extend its packed segment
+        grants = self.sched.plan_prefill_grants(self._chunk_budget,
+                                                self._chunk)
+        # speculation over what the grants left, then page growth sized
+        # to each row's appends (drafts dropped before anyone is evicted)
+        spec_plan = self._plan_speculation(grants)
+        self._grow_decode_pages(spec_plan)
+        # RE-plan grants: growth may have preempted a grantee, and its
+        # share must redistribute to the surviving prefillers instead of
+        # being silently wasted this step. The re-planned total can only
+        # shrink or redistribute (bounded by the same budget and a
+        # smaller owed set), so the packed capacity the speculation plan
+        # was sized against still holds
         grants = self.sched.plan_prefill_grants(self._chunk_budget,
                                                 self._chunk)
         for _, req in list(self.sched.active()):
@@ -1043,7 +1310,9 @@ class ServingEngine:
             self._write_table_row(req)
 
         # pack segments slot-ascending (the ragged kernel's contract) —
-        # decode rows are 1 token, granted prefill rows up to their grant,
+        # decode rows are 1 token (1 + k for a speculating row: the last
+        # committed token plus its drafts, a prefill-like verify segment
+        # starting at seq_len), granted prefill rows up to their grant,
         # everything else (empty slots, un-granted prefillers) is inert
         R, T = cfg.max_batch_size, self._mixed_tokens
         ids = np.zeros((1, T), np.int32)
@@ -1073,13 +1342,18 @@ class ServingEngine:
                                  start + n >= req.prefill_target))
                 cursor += n
             else:
+                drafts = spec_plan.get(req.rid) or []
+                n = 1 + len(drafts)
                 ids[0, cursor] = self._last_tok[slot]
-                pos[0, cursor] = req.seq_len
-                trow[0, cursor] = slot
-                row_start[slot], row_len[slot] = cursor, 1
-                row_cs[slot], row_cl[slot] = req.seq_len, req.seq_len + 1
-                decodes.append((slot, req))
-                cursor += 1
+                if drafts:
+                    ids[0, cursor + 1:cursor + n] = drafts
+                pos[0, cursor:cursor + n] = \
+                    np.arange(req.seq_len, req.seq_len + n)
+                trow[0, cursor:cursor + n] = slot
+                row_start[slot], row_len[slot] = cursor, n
+                row_cs[slot], row_cl[slot] = req.seq_len, req.seq_len + n
+                decodes.append((slot, req, drafts))
+                cursor += n
         assert cursor <= T, f"packed {cursor} tokens into a {T}-token step"
         if cursor == 0:
             self._finish_step_bookkeeping(t0, brownout)
@@ -1093,13 +1367,13 @@ class ServingEngine:
         # budget on a step it can actually poison
         corrupt = np.zeros((R,), bool)
         if decodes:
-            spec = fault_injection.maybe_flag("corrupt_logits",
-                                              tag="serving_step",
-                                              step=self._step_no)
-            if spec is not None:
-                decode_slots = {s for s, _ in decodes}
+            fspec = fault_injection.maybe_flag("corrupt_logits",
+                                               tag="serving_step",
+                                               step=self._step_no)
+            if fspec is not None:
+                decode_slots = {s for s, _, _ in decodes}
                 try:
-                    pin = int(spec.params["slot"])
+                    pin = int(fspec.params["slot"])
                 except (KeyError, ValueError):
                     pin = decodes[0][0]
                 if pin not in decode_slots:
@@ -1110,13 +1384,21 @@ class ServingEngine:
                 step=self._step_no) is not None:
             corrupt[prefills[0][0]] = True
 
+        # packed width: the full capacity, or — with mixed_step_buckets —
+        # the narrowest compiled bucket that fits this step's packed
+        # tokens (decode-only steps stop paying the full padded batch)
+        W = T
+        if self._bucket_widths is not None:
+            W = next(w for w in self._bucket_widths if w >= cursor)
+
         self._rng, rng = jax.random.split(self._rng)
         step_no = self._step_no
         # snapshot everything the guarded thread touches on THIS thread
         # (the watchdog-abandonment rule of the legacy decode step)
         call_args = (self.engine.params, self.pool,
                      jnp.asarray(self._tables),
-                     jnp.asarray(ids), jnp.asarray(trow), jnp.asarray(pos),
+                     jnp.asarray(ids[:, :W]), jnp.asarray(trow[:, :W]),
+                     jnp.asarray(pos[:, :W]),
                      jnp.asarray(row_start), jnp.asarray(row_len),
                      jnp.asarray(row_cs), jnp.asarray(row_cl),
                      jnp.asarray(corrupt), rng)
@@ -1135,24 +1417,26 @@ class ServingEngine:
                 fault_injection.maybe_stall("slow_chunk",
                                             tag="serving_prefill",
                                             step=step_no)
-            return self._mixed_dispatch(call_args)
+            return self._mixed_dispatch(call_args, W)
 
         tr = self.tracer
         t_dev = time.perf_counter()
-        was_warm = self._mixed_warm
+        # first-beat rule per WIDTH: each bucket's first call carries its
+        # own XLA compile and is never watchdog-judged; steady-state
+        # wedges always are
+        was_warm = W in self._warm_widths
         try:
-            # first-beat rule: the compile-carrying first call is never
-            # watchdog-judged; steady-state wedges always are
             if was_warm:
                 toks, bad, self.pool = self._guarded(device_step)
             else:
                 toks, bad, self.pool = device_step()
+                self._warm_widths.add(W)
                 self._mixed_warm = True
         except StepWatchdogTimeout as e:
             log_dist(f"serving: step watchdog tripped: {e}", ranks=[0])
             self.metrics.watchdog_trips += 1
             self._last_trip_time = time.perf_counter()
-            packed = [(s, r) for s, r in decodes] + \
+            packed = [(s, r) for s, r, _ in decodes] + \
                      [(s, r) for s, r, _, _ in prefills]
             rids = [r.rid for _, r in packed]
             if tr.enabled:
@@ -1166,22 +1450,23 @@ class ServingEngine:
                          budget_s=cfg.step_watchdog_s)
         else:
             t_end = time.perf_counter()
-            n_prefill = cursor - len(decodes)
+            n_decode_packed = sum(1 + len(d) for _, _, d in decodes)
+            n_prefill = cursor - n_decode_packed
+            n_drafted = n_decode_packed - len(decodes)
             if tr.enabled:
                 # the one engine span of the unified step, carrying the
-                # per-row decode/prefill token split (what decode_step +
-                # chunked_prefill used to say in two spans)
+                # per-row decode/prefill/verify token split (what
+                # decode_step + chunked_prefill used to say in two spans)
                 tr.complete("mixed_step", t_dev, t_end, cat="engine",
                             args={"step": step_no,
                                   "decode_tokens": len(decodes),
+                                  "verify_tokens": n_drafted,
                                   "prefill_tokens": n_prefill,
+                                  "width": W,
                                   "rows": len(decodes) + len(prefills)})
-            if was_warm:
-                # first-beat rule for gauges too (compile wall time would
-                # report garbage utilization)
-                self._note_mixed_perf(t_end - t_dev, tokens=cursor)
             toks = np.asarray(toks)
             bad = np.asarray(bad)
+            committed = 0
             for slot, req, n, final in prefills:
                 start = req.prefill_done
                 req.prefill_done = start + n
@@ -1189,6 +1474,7 @@ class ServingEngine:
                 self.metrics.prefill_tokens += n
                 self.metrics.prefill_tokens_computed += n
                 self.metrics.window_tokens += n
+                committed += n
                 # guard EVERY chunk and BEFORE content-indexing: poisoned
                 # KV must never park on the prefix-cache LRU
                 if cfg.logit_guard and bad[slot]:
@@ -1196,47 +1482,91 @@ class ServingEngine:
                     continue
                 self._commit_full_blocks(req)
                 if final:
-                    # last chunk: token one (TTFT ends here); the slot
-                    # decodes from the NEXT step on
+                    # last chunk: token one (TTFT ends here) — the row's
+                    # LAST packed position; the slot decodes next step
                     self._seq_lens[slot] = req.seq_len
-                    self._harvest(req, int(toks[slot]))
-            for slot, req in decodes:
+                    self._harvest(
+                        req,
+                        int(toks[row_start[slot] + row_len[slot] - 1]))
+                    committed += 1
+            had_verify = False
+            for slot, req, drafts in decodes:
                 if cfg.logit_guard and bad[slot]:
+                    # one poisoned position anywhere in the row (drafts
+                    # included) fails ITS request; nothing from the row
+                    # commits, so poisoned KV can neither be harvested
+                    # nor content-indexed
                     self._quarantine(slot, req, step_no, where="decode")
+                    continue
+                if drafts:
+                    # verify row: greedy accept-prefix over the row's
+                    # k + 1 predictions, rollback past the accepted KV
+                    preds = [int(toks[row_start[slot] + j])
+                             for j in range(len(drafts) + 1)]
+                    committed += self._commit_verify_row(slot, req,
+                                                         drafts, preds)
+                    had_verify = True
                     continue
                 req.seq_len += 1
                 self._seq_lens[slot] = req.seq_len
                 # a generated token may have just FILLED a page —
                 # content-index it so identical continuations hit
                 self._commit_full_blocks(req)
-                self._harvest(req, int(toks[slot]))
+                self._harvest(req, int(toks[row_start[slot]]))
+                committed += 1
+            if had_verify:
+                self.metrics.spec_steps += 1
+            if was_warm:
+                # first-beat rule for gauges too (compile wall time would
+                # report garbage utilization). Tokens = what the step
+                # COMMITTED (prefill progress + decode commits): rejected
+                # draft positions are real FLOPs but not throughput —
+                # they are the overhead speculation pays, reported via
+                # spec_drafted/spec_accepted, never folded into tokens/sec
+                self._note_mixed_perf(t_end - t_dev, tokens=committed,
+                                      width=W)
 
         self._finish_step_bookkeeping(t0, brownout)
 
-    def _mixed_dispatch(self, call_args):
-        """The ONE observed entry to the resident mixed program. Every
-        dispatch is fingerprint-observed first (shapes/dtypes/statics): a
-        fingerprint change IS a recompile, so the sentinel fires a
-        `recompile` tracer event + registry counter naming the offending
-        argument before the stall even happens. The first call also
-        captures the program's cost model for MFU/MBU."""
-        if self._mixed_fn is None:
-            self._mixed_fn = self._build_mixed_step()
+    def _mixed_name(self, width: int) -> str:
+        """Perf-registry name of the resident mixed program at ``width``
+        — ONE name by default (the one-compile invariant's key), one per
+        bucket with ``mixed_step_buckets`` (each bucket is its own
+        resident program with its own fingerprint, so dispatching across
+        buckets never reads as a recompile)."""
+        return "mixed_step" if self._bucket_widths is None \
+            else f"mixed_step[{width}]"
+
+    def _mixed_dispatch(self, call_args, width: Optional[int] = None):
+        """The ONE observed entry to the resident mixed program (per
+        packed width when bucketing). Every dispatch is
+        fingerprint-observed first (shapes/dtypes/statics): a fingerprint
+        change IS a recompile, so the sentinel fires a `recompile` tracer
+        event + registry counter naming the offending argument before the
+        stall even happens. The first call also captures the program's
+        cost model for MFU/MBU."""
+        if width is None:
+            width = self._mixed_tokens
+        name = self._mixed_name(width)
+        fn = self._mixed_fns.get(width)
+        if fn is None:
+            fn = self._mixed_fns[width] = self._build_mixed_step(width)
         (params, pool, tables, ids, token_rows, append_pos, row_start,
          row_len, chunk_start, context_len, corrupt, rng) = call_args
         self.perf.observe_call(
-            "mixed_step",
+            name,
             params=self.perf.cached_spec("params", params),
             pool=pool, tables=tables, ids=ids, token_rows=token_rows,
             append_pos=append_pos, row_start=row_start, row_len=row_len,
             chunk_start=chunk_start, context_len=context_len,
             corrupt=corrupt, rng=rng)
-        out = self._mixed_fn(*call_args)
-        if self.perf.programs.program("mixed_step").cost_pending:
+        out = fn(*call_args)
+        if self.perf.programs.program(name).cost_pending:
             # first call (watchdog-exempt): lowering is cached by jax, so
             # this pays no second trace and no XLA compile
-            self.perf.capture_cost("mixed_step", self._mixed_fn, call_args,
-                                   fallback=self._mixed_cost_estimate)
+            self.perf.capture_cost(
+                name, fn, call_args,
+                fallback=lambda: self._mixed_cost_estimate(width))
         return out
 
     def _quarantine(self, slot: int, req: Request, step_no: int,
@@ -1255,11 +1585,14 @@ class ServingEngine:
         self._flight("logit_quarantine", rid=req.rid, slot=slot,
                      step=step_no, where=where)
 
-    def _note_mixed_perf(self, dt_s: float, tokens: int) -> None:
+    def _note_mixed_perf(self, dt_s: float, tokens: int,
+                         width: Optional[int] = None) -> None:
         """Per-step utilization of the unified program (serving snapshot +
         flight dumps): MBU stays the honest gauge — the step is still
         dominated by the param + KV read."""
-        vals = self.perf.on_program_step("mixed_step", dt_s, tokens=tokens)
+        name = self._mixed_name(width if width is not None
+                                else self._mixed_tokens)
+        vals = self.perf.on_program_step(name, dt_s, tokens=tokens)
         m = self.metrics
         m.mixed_flops_per_step = vals["flops_per_step"]
         m.mixed_bytes_per_step = vals["bytes_per_step"]
@@ -1267,7 +1600,7 @@ class ServingEngine:
         m.mixed_mbu = vals["mbu"]
         m.mixed_tokens_per_sec_per_chip = vals["tokens_per_sec_per_chip"]
 
-    def _mixed_cost_estimate(self):
+    def _mixed_cost_estimate(self, width: Optional[int] = None):
         """Hand-rolled mixed-step cost where the backend has no cost
         model: the packed batch computes every padded token position and
         reads params once + every row's table-width KV walk — exactly the
@@ -1277,8 +1610,8 @@ class ServingEngine:
             return None
         B, ctx = self.config.max_batch_size, self.config.max_model_len
         return {
-            "flops": self._mixed_tokens * transformer_flops_per_token(
-                mcfg, ctx),
+            "flops": (width if width is not None else self._mixed_tokens)
+            * transformer_flops_per_token(mcfg, ctx),
             "bytes_accessed": estimate_decode_step_bytes(
                 mcfg, B, ctx, param_bytes(self.engine.params),
                 kv_bytes_per_elem=self._kv_bytes_per_elem),
@@ -1429,6 +1762,35 @@ class ServingEngine:
         out = self.perf.summary()
         out["compile_counts"] = dict(self.compile_counts)
         return out
+
+    @property
+    def mixed_step_widths(self) -> List[int]:
+        """Packed widths the mixed step may dispatch at: the full
+        capacity alone by default, the bounded bucket set with
+        ``mixed_step_buckets`` (``compile_counts["mixed_step"]`` is
+        bounded by its length)."""
+        if not self._mixed:
+            return []
+        return list(self._bucket_widths) if self._bucket_widths is not None \
+            else [self._mixed_tokens]
+
+    def speculation_status(self) -> Dict[str, Any]:
+        """Speculative-decoding status for CLI reports (``ds_serve``
+        final report, ``ds_report`` next to the compiled-program table):
+        drafter kind, configured cap, and the rolling acceptance
+        numbers. ``enabled`` False when speculation is off."""
+        m = self.metrics
+        return {
+            "enabled": self._drafter is not None,
+            "drafter": self._drafter.kind if self._drafter is not None
+            else None,
+            "spec_tokens": self.config.spec_tokens,
+            "drafted": m.spec_drafted,
+            "accepted": m.spec_accepted,
+            "accept_rate": round(m.spec_accept_rate, 4),
+            "tokens_per_verify": round(m.spec_tokens_per_verify, 4),
+            "pages_dropped": m.spec_pages_dropped,
+        }
 
     def _write_table_row(self, req: Request) -> None:
         row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)
@@ -1758,28 +2120,35 @@ class ServingEngine:
         return dequantize_params(qparams, self.engine._dequant_meta,
                                  self.engine.compute_dtype)
 
-    def _build_mixed_step(self):
-        """The ONE resident serving program. Shapes are fixed — a packed
-        ``[1, mixed_tokens]`` ragged token batch against the full pool —
+    def _build_mixed_step(self, t_tokens: Optional[int] = None):
+        """The ONE resident serving program (one per packed width with
+        ``mixed_step_buckets``). Shapes are fixed — a packed
+        ``[1, t_tokens]`` ragged token batch against the full pool —
         and EVERYTHING ragged rides as data: per-token table rows and
         absolute positions, per-slot segment offsets/lengths, chunk
-        starts, context lengths, block tables. Decode rows and prefill
-        chunks share the unified ragged attention grid
-        (``ops/pallas/ragged_attention.py`` on TPU, the packed XLA
-        reference elsewhere), every row samples its last valid position,
-        and the host keeps only the tokens it asked for — so any traffic
-        mix, chunk schedule or cache-hit pattern reuses ONE executable."""
+        starts, context lengths, block tables. Decode rows, speculative
+        verify rows and prefill chunks share the unified ragged attention
+        grid (``ops/pallas/ragged_attention.py`` on TPU, the packed XLA
+        reference elsewhere). EVERY packed position is sampled (the
+        multi-position harvest): the host gathers a decode row's one
+        prediction, a verify row's ``k + 1`` predictions (the greedy
+        accept-prefix input) or a final chunk's token one from the same
+        ``[T]`` output — so any traffic mix, draft schedule, chunk
+        schedule or cache-hit pattern reuses ONE executable."""
         module, scfg = self.engine.module, self.config
-        T = self._mixed_tokens
+        if t_tokens is None:
+            t_tokens = self._mixed_tokens
+        R = scfg.max_batch_size
+        name = self._mixed_name(t_tokens)
 
         def mixed_step(params, pool, tables, ids, token_rows, append_pos,
                        row_start, row_len, chunk_start, context_len,
                        corrupt, rng):
             # trace-time side effect: runs once per XLA compile
             self.compile_counts["mixed_step"] += 1
-            self.perf.note_compile("mixed_step")
+            self.perf.note_compile(name)
             self.tracer.instant("xla_compile", cat="engine",
-                                args={"kind": "mixed_step"})
+                                args={"kind": name})
             params = self._dequant(params)
             idx = paged_cache_index(tables, append_pos, context_len,
                                     chunk_start=chunk_start,
@@ -1788,18 +2157,13 @@ class ServingEngine:
                                     query_len=row_len)
             logits, pool = module.apply({"params": params}, ids, cache=pool,
                                         cache_index=idx)
-            # each row's last valid packed position: the next token for
-            # decode rows, token one for a final chunk, discarded for
-            # mid-prompt chunks; inert rows read position 0 (never
-            # consumed by the host)
-            last_idx = jnp.clip(row_start + row_len - 1, 0, T - 1)
-            last = logits[0, last_idx]
-            # corrupt_logits chaos: NaN flagged rows as DATA (no recompile)
-            last = jnp.where(corrupt[:, None],
-                             jnp.asarray(jnp.nan, last.dtype), last)
-            # output guard: per-row NaN/Inf flag, computed on-device
-            bad = ~jnp.isfinite(last).all(axis=-1)
-            tok = _sample_logits(last, rng, scfg.do_sample,
+            # multi-position harvest: per-position logits (chaos NaN
+            # applied per flagged row, as DATA) + per-row NaN/Inf flag
+            # OR-reduced over each row's valid tokens — one poisoned
+            # draft position quarantines its request, never the batch
+            lg, bad = harvest_packed_logits(logits, token_rows, R,
+                                            corrupt=corrupt)
+            tok = _sample_logits(lg, rng, scfg.do_sample,
                                  scfg.temperature, scfg.top_k, scfg.top_p)
             return tok.astype(jnp.int32), bad, pool
 

@@ -77,6 +77,27 @@ class ServingMetrics:
     #: copy-on-write page forks (appends routed off shared pages)
     cow_copies: int = 0
     tokens_generated: int = 0
+    # -- speculative decoding (the verify rows of the mixed step) -------
+    #: draft tokens packed into verify rows (accepted or not — the
+    #: denominator of the accept rate, and the honest measure of the
+    #: extra verify work speculation buys its speedup with)
+    spec_drafted: int = 0
+    #: draft tokens the target model's greedy predictions confirmed
+    #: (each one is a generated token that skipped its own dispatch)
+    spec_accepted: int = 0
+    #: tokens committed by verify rows (accepted drafts + the bonus
+    #: token every verify row yields) — the numerator of
+    #: ``spec_tokens_per_verify``
+    spec_committed: int = 0
+    #: verify rows committed (one per speculating resident per step —
+    #: the honest denominator: dividing by steps would inflate the
+    #: gauge with batch occupancy)
+    spec_verify_rows: int = 0
+    #: steps that packed at least one verify row
+    spec_steps: int = 0
+    #: pool pages dropped by speculative rollback (whole pages past the
+    #: accepted prefix, returned through the reference sets)
+    spec_pages_dropped: int = 0
     steps: int = 0
     # gauges (overwritten each step)
     queue_depth: int = 0
@@ -214,6 +235,25 @@ class ServingMetrics:
             if self.prefill_tokens else 0.0
 
     @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens the target model confirmed; 0 with
+        no drafts yet (an engine that never speculates reports 0, not a
+        fake 1)."""
+        return self.spec_accepted / self.spec_drafted \
+            if self.spec_drafted else 0.0
+
+    @property
+    def spec_tokens_per_verify(self) -> float:
+        """Tokens committed per VERIFY ROW (accepted drafts + bonus;
+        1.0 means that row did exactly what plain decode would have).
+        Per row, not per step — dividing by steps would fold batch
+        occupancy into the gauge (8 residents all rejecting everything
+        would read as 8.0 'per step' while being exactly plain
+        decode)."""
+        return self.spec_committed / self.spec_verify_rows \
+            if self.spec_verify_rows else 0.0
+
+    @property
     def goodput_tokens_per_sec(self) -> float:
         """Generated-token throughput counting ONLY requests that met
         their SLO (same window discipline as ``tokens_per_sec``): the
@@ -272,6 +312,12 @@ class ServingMetrics:
             "goodput_tokens": float(self.goodput_tokens),
             "goodput_tokens_per_sec": self.goodput_tokens_per_sec,
             "slo_burn_rate": self.slo_burn_rate,
+            "spec_drafted": float(self.spec_drafted),
+            "spec_accepted": float(self.spec_accepted),
+            "spec_accept_rate": self.spec_accept_rate,
+            "spec_tokens_per_verify": self.spec_tokens_per_verify,
+            "spec_steps": float(self.spec_steps),
+            "spec_pages_dropped": float(self.spec_pages_dropped),
         }
         for key in ("decode_flops_per_step", "decode_bytes_per_step",
                     "decode_mfu", "decode_mbu",

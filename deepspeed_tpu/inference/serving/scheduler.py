@@ -104,6 +104,19 @@ class Request:
     #: one of metrics.SLO_VERDICTS) — rides the terminal "request" span
     #: so trace_view can break SLO misses down by phase
     slo_verdict: Optional[str] = None
+    # -- speculative decoding (engine.py drives; see serving/speculative.py)
+    #: adaptive per-request draft-length cap: -1 = unset (the engine
+    #: seeds it from ``ServingConfig.spec_tokens`` on first use), then
+    #: grown on full accepts and halved on full rejects so a resident
+    #: whose drafter keeps missing stops paying verify tokens for nothing
+    spec_k: int = -1
+    #: EXPONENTIALLY-DECAYED draft/accept counters (the engine decays
+    #: both before each verify commit, so their ratio is the RECENT
+    #: accept rate — a request whose stream turns predictable must not
+    #: stay gated by misses from fifty tokens ago). Engine-wide totals
+    #: live in ServingMetrics; these exist only for the adaptive cap.
+    spec_drafted: float = 0.0
+    spec_accepted: float = 0.0
     preemptions: int = 0
     admit_order: int = -1     # monotone stamp set at admission (victim pick)
     #: latest admission stamp (perf_counter seconds; None while queued)
@@ -398,10 +411,16 @@ class Scheduler:
 
     # -- decode-time page growth / preemption --------------------------
 
-    def ensure_decode_headroom(self, req: Request) -> bool:
-        """Make sure the page holding position ``seq_len`` exists (the next
-        decode step appends there). False = pool dry, caller must preempt."""
-        need_idx = req.seq_len // self.pool.block_size
+    def ensure_decode_headroom(self, req: Request, lookahead: int = 0
+                               ) -> bool:
+        """Make sure the pages holding positions ``seq_len .. seq_len +
+        lookahead`` exist (the next step appends there: one token for a
+        plain decode row, ``1 + k`` for a verify row carrying ``k``
+        drafted tokens). False = pool dry, caller must preempt — or, on
+        the speculative path, first drop the drafts and retry with
+        ``lookahead=0`` so speculation degrades before anyone is
+        evicted."""
+        need_idx = (req.seq_len + lookahead) // self.pool.block_size
         while len(req.blocks) <= need_idx:
             if not self.pool.can_allocate(1):
                 return False

@@ -635,6 +635,37 @@ def ragged_mixed_attention_reference(q, layer_cache, cache_index,
     return out.reshape(B, T, H, D)
 
 
+def harvest_packed_logits(logits, token_rows, num_rows, corrupt=None):
+    """Multi-position harvest of the packed ragged mixed step.
+
+    ``logits``: ``[1, T, V]`` over the packed token axis; ``token_rows``:
+    ``[1, T]`` (or ``[T]``) mapping each packed token to its descriptor
+    row (``-1`` = padding). Returns ``(lg, bad)``:
+
+    - ``lg``: ``[T, V]`` per-POSITION logits, chaos-corruption applied
+      (``corrupt``: optional ``[R]`` bool — flagged rows' valid tokens go
+      NaN as DATA, so drills never recompile). The caller samples every
+      position and gathers what it needs per row: position ``query_start``
+      alone for a plain decode row, all ``k + 1`` positions of a verify
+      row (speculative decoding's accept-prefix input), the last position
+      of a final prefill chunk. Padding positions carry garbage the host
+      never reads.
+    - ``bad``: ``[R]`` per-row NaN/Inf flag OR-reduced over the row's
+      valid tokens — one poisoned position anywhere in a verify row or
+      chunk quarantines that row's request, never the batch.
+    """
+    lg = logits[0]
+    rows = jnp.asarray(token_rows, jnp.int32).reshape(-1)
+    valid = rows >= 0
+    safe = jnp.clip(rows, 0, num_rows - 1)
+    if corrupt is not None:
+        hit = jnp.asarray(corrupt, bool)[safe] & valid
+        lg = jnp.where(hit[:, None], jnp.asarray(jnp.nan, lg.dtype), lg)
+    bad_tok = ~jnp.isfinite(lg).all(axis=-1) & valid
+    bad = jnp.zeros((num_rows,), bool).at[safe].max(bad_tok)
+    return lg, bad
+
+
 def copy_paged_blocks(pool, src_ids, dst_ids):
     """Device-side page copy ``pool[:, dst] = pool[:, src]`` across every
     pool array (K, V, int8 scales) — the copy half of copy-on-write when a

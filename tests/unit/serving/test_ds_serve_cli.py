@@ -159,6 +159,28 @@ def test_admin_port_live_process_answers_control_plane(tmp_path):
     assert "goodput_tok/s=" not in out  # stats line stays on stderr
 
 
+def test_spec_tokens_demo_reports_speculation(tmp_path):
+    """--spec-tokens arms prompt-lookup speculation end to end through
+    the CLI: the run serves, the stats line carries acceptance, and the
+    final report's speculation block names the drafter and counters."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "6", "--cpu", "--spec-tokens", "4",
+         "--max-new-tokens", "24", "--stats-interval-s", "1"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    final = lines[-1]
+    spec = final["speculation"]
+    assert spec["enabled"] and spec["drafter"] == "prompt_lookup"
+    assert spec["spec_tokens"] == 4
+    assert spec["drafted"] >= 0 and 0.0 <= spec["accept_rate"] <= 1.0
+    assert final["serving_metrics"]["spec_drafted"] == spec["drafted"]
+    assert final["serving_metrics"]["compile_counts"] == {"mixed_step": 1}
+    assert "spec_acc=" in r.stderr, "stats line must carry acceptance"
+
+
 def test_demo_cannot_mix_with_prompts(tmp_path):
     p = tmp_path / "p.jsonl"
     p.write_text('{"prompt_ids": [1]}\n')

@@ -1,0 +1,352 @@
+"""Speculative decoding on the paged serving engine.
+
+The contract under test: with ``ServingConfig.spec_tokens > 0`` the
+engine drafts k tokens per decoding resident (prompt-lookup by default,
+any :class:`~deepspeed_tpu.inference.serving.Drafter` pluggable), packs
+each as ONE verify row of the SAME resident mixed step (``query_len =
+k + 1``), greedily accepts the longest confirmed prefix plus the model's
+bonus token, and rolls rejected KV back by rewinding ``seq_len`` —
+partial pages are overwritten by the next append, whole rejected pages
+drop through the reference sets, and a rejected token's page hash can
+NEVER enter the prefix-cache content index. Greedy output must be
+token-IDENTICAL to the plain engine under every mix (preemption
+mid-speculation, prefix-cache hits, EOS inside an accepted run, k=0
+fallback), with ``compile_counts == {"mixed_step": 1}`` and the
+recompile sentinel silent.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import (Drafter, PromptLookupDrafter,
+                                             ServingConfig, ServingEngine)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+class _OracleDrafter(Drafter):
+    """Test drafter that replays a precomputed continuation per prompt —
+    deterministic 100% acceptance, so multi-token commits and the
+    adaptive-cap growth path are exercised without relying on the tiny
+    model's repetition habits."""
+
+    kind = "oracle"
+
+    def __init__(self, table):
+        # {tuple(prompt): full plain-engine output}; longest prompt
+        # matched first so shared-prefix prompts resolve correctly
+        self.table = sorted(table.items(), key=lambda kv: -len(kv[0]))
+
+    def draft(self, history, k):
+        h = list(history)
+        for p, toks in self.table:
+            if h[:len(p)] == list(p):
+                done = len(h) - len(p)
+                return list(toks[done:done + k])
+        return []
+
+
+class _WrongDrafter(Drafter):
+    """Always-wrong drafts (vocab-edge token repeated): every verify row
+    rejects everything, so rollback runs at full tilt every step."""
+
+    kind = "wrong"
+
+    def __init__(self, token):
+        self.token = token
+
+    def draft(self, history, k):
+        return [self.token] * k
+
+
+def _serve(engine, prompts, new, eos=None, **cfg_over):
+    srv = ServingEngine(engine, ServingConfig(**cfg_over))
+    rids = [srv.submit(p, max_new_tokens=n, eos_token_id=eos)
+            for p, n in zip(prompts, new)]
+    res = srv.run()
+    outs = [(res[r].state, res[r].finish_reason, res[r].tokens)
+            for r in rids]
+    # rollback invariants after EVERY run: zero leaked pages, zero
+    # stranded cached pages (check_consistent rejects cached pages
+    # missing from the content index)
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0, "leaked blocks"
+    return outs, srv
+
+
+# ---------------------------------------------------------------------
+# drafter unit behavior
+# ---------------------------------------------------------------------
+
+def test_prompt_lookup_drafter_matches_and_falls_back():
+    d = PromptLookupDrafter(max_ngram=3, min_ngram=1)
+    # trailing trigram [7, 8, 9] occurred earlier; continuation follows it
+    assert d.draft([1, 7, 8, 9, 4, 5, 7, 8, 9], 2) == [4, 5]
+    # k truncates the proposal
+    assert d.draft([1, 7, 8, 9, 4, 5, 7, 8, 9], 1) == [4]
+    # no trigram/bigram match -> unigram fallback: last 9 matched mid-list
+    assert d.draft([9, 1, 2, 9, 3, 4, 9], 3) == [3, 4, 9]
+    # most RECENT earlier occurrence wins (9 appears twice)
+    assert d.draft([9, 5, 9, 6, 9], 2) == [6, 9]
+    # nothing repeats -> no draft
+    assert d.draft([1, 2, 3, 4, 5], 4) == []
+    # degenerate inputs
+    assert d.draft([], 4) == []
+    assert d.draft([1], 4) == []
+    assert d.draft([1, 1, 1], 0) == []
+
+
+def test_prompt_lookup_drafter_validation():
+    with pytest.raises(ValueError):
+        PromptLookupDrafter(max_ngram=0)
+    with pytest.raises(ValueError):
+        PromptLookupDrafter(max_ngram=2, min_ngram=3)
+
+
+def test_spec_config_validation(llama_engine):
+    with pytest.raises(ValueError, match="spec_tokens"):
+        ServingEngine(llama_engine, ServingConfig(spec_tokens=-1))
+    with pytest.raises(ValueError, match="greedy"):
+        ServingEngine(llama_engine, ServingConfig(spec_tokens=4,
+                                                  do_sample=True))
+    with pytest.raises(ValueError, match="mixed"):
+        ServingEngine(llama_engine, ServingConfig(spec_tokens=4,
+                                                  mixed_step=False))
+    with pytest.raises(ValueError, match="mixed"):
+        ServingEngine(llama_engine, ServingConfig(mixed_step=False,
+                                                  mixed_step_buckets=True))
+
+
+# ---------------------------------------------------------------------
+# greedy token identity (the acceptance bar)
+# ---------------------------------------------------------------------
+
+def test_spec_token_identity_randomized_traffic(llama_engine):
+    """The property test: randomized mixed traffic — shared prefixes
+    (cache hits), a pool small enough to preempt mid-speculation, EOS
+    picked from the plain run so it actually fires, prompt-lookup
+    drafting — produces byte-identical greedy output to the plain
+    engine, with ONE resident compile and a silent sentinel."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(17)
+    prefix = rs.randint(1, vocab, 16)
+    prompts = [np.concatenate([prefix, rs.randint(1, vocab, int(t))])
+               for t in (3, 6, 2)]
+    prompts += [rs.randint(1, vocab, int(n)) for n in (5, 19, 11, 8)]
+    new = [14, 10, 16, 12, 18, 10, 15]
+    kw = dict(max_batch_size=3, block_size=8, num_blocks=11,
+              max_model_len=128, prefix_cache=True,
+              prefill_chunk_tokens=8, prefill_token_budget=16)
+    plain, srv_p = _serve(llama_engine, prompts, new, **kw)
+    # an EOS that provably occurs mid-stream in the plain output
+    eos = plain[4][2][3]
+    plain_eos, _ = _serve(llama_engine, prompts, new, eos=eos, **kw)
+    spec, srv_s = _serve(llama_engine, prompts, new, spec_tokens=6, **kw)
+    spec_eos, srv_e = _serve(llama_engine, prompts, new, eos=eos,
+                             spec_tokens=6, **kw)
+    assert spec == plain, "speculative greedy output diverged"
+    assert spec_eos == plain_eos, "EOS handling diverged under speculation"
+    assert any(reason == "eos" for _, reason, _ in spec_eos), \
+        "picked EOS never fired — the eos-inside-speculation path was " \
+        "not exercised"
+    assert srv_s.metrics.preemptions > 0, "pool sized to force preemption"
+    assert srv_s.metrics.spec_drafted > 0, "traffic never drafted"
+    for srv in (srv_s, srv_e):
+        assert srv.compile_counts == {"mixed_step": 1}, srv.compile_counts
+        assert srv.perf.recompile_total == 0
+    # k=0 fallback is the plain engine itself (srv_p): same compile story
+    assert srv_p.compile_counts == {"mixed_step": 1}
+    assert srv_p.metrics.spec_drafted == 0
+
+
+def test_oracle_full_accept_multi_token_commits(llama_engine):
+    """A 100%-accept drafter must commit k+1 tokens per verify row (the
+    whole point of the optimization), finish in measurably fewer steps
+    than the plain engine, and grow the adaptive cap to the config
+    maximum."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(23)
+    prompts = [rs.randint(1, vocab, int(n)) for n in (9, 14, 6)]
+    new = [24, 24, 24]
+    kw = dict(max_batch_size=3, block_size=8, num_blocks=64,
+              max_model_len=128, prefix_cache=True)
+    plain, srv_p = _serve(llama_engine, prompts, new, **kw)
+    oracle = _OracleDrafter({tuple(int(t) for t in p): toks
+                             for p, (_, _, toks) in zip(prompts, plain)})
+    spec, srv_s = _serve(llama_engine, prompts, new, spec_tokens=6,
+                         drafter=oracle, **kw)
+    assert spec == plain
+    m = srv_s.metrics
+    assert m.spec_accept_rate == 1.0, \
+        f"oracle drafts must all be accepted ({m.spec_accepted}/" \
+        f"{m.spec_drafted})"
+    assert m.spec_tokens_per_verify > 2.0
+    assert m.steps < srv_p.metrics.steps / 2, \
+        f"full-accept speculation must collapse the step count " \
+        f"({m.steps} vs plain {srv_p.metrics.steps})"
+    # adaptive cap grew back to the config maximum on full accepts
+    assert all(r.spec_k == 6 for r in srv_s._requests.values())
+
+
+def test_wrong_drafts_identity_rollback_and_adaptive_shrink(llama_engine):
+    """Always-rejected drafts: output identical (the bonus token is the
+    plain prediction), every step rolls back, and the adaptive cap
+    shrinks to its floor so the request stops paying full-width verify
+    rows for nothing."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(29)
+    prompts = [rs.randint(1, vocab - 2, int(n)) for n in (7, 12)]
+    new = [20, 20]
+    kw = dict(max_batch_size=2, block_size=4, num_blocks=64,
+              max_model_len=128, prefix_cache=True)
+    plain, _ = _serve(llama_engine, prompts, new, **kw)
+    wrong = _WrongDrafter(vocab - 1)
+    spec, srv = _serve(llama_engine, prompts, new, spec_tokens=8,
+                       drafter=wrong, **kw)
+    assert spec == plain
+    m = srv.metrics
+    assert m.spec_drafted > 0
+    # the plain greedy stream could legitimately emit vocab-1 now and
+    # then; what must hold is near-total rejection, not exactly zero
+    assert m.spec_accept_rate < 0.2
+    assert all(r.spec_k == 1 for r in srv._requests.values()), \
+        "full rejects must shrink the adaptive cap to its floor"
+    # block_size 4 with k up to 8: whole rejected pages existed and were
+    # dropped through the reference sets
+    assert m.spec_pages_dropped > 0
+
+
+def test_rejected_token_hash_never_enters_content_index(llama_engine):
+    """THE cache-poisoning pin: with always-rejected drafts spanning
+    whole pages, every ChainKey in the content index must be a prefix
+    chain of some request's COMMITTED tokens — a hash covering rejected
+    draft content must not exist, or the next identical prompt would be
+    served wrong KV."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(31)
+    prompts = [rs.randint(1, vocab - 2, int(n)) for n in (9, 6)]
+    new = [22, 18]
+    _, srv = _serve(llama_engine, prompts, new, spec_tokens=8,
+                    drafter=_WrongDrafter(vocab - 1),
+                    max_batch_size=2, block_size=4, num_blocks=64,
+                    max_model_len=128, prefix_cache=True)
+    assert srv.metrics.spec_drafted > 0
+    pool = srv.block_pool
+    allowed = set()
+    for req in srv._requests.values():
+        allowed.update(pool.prefix_block_hashes(req.resume_tokens))
+    indexed = set(pool._hash_to_block)
+    assert indexed <= allowed, \
+        f"{len(indexed - allowed)} content-index entries cover tokens " \
+        f"no request ever committed (rejected-draft pages were indexed)"
+
+
+def test_spec_degrades_under_prefill_pressure(llama_engine):
+    """A tiny packed budget with long prompts chunking through it:
+    verify rows may only spend LEFTOVER capacity, so admissions/prefill
+    never starve, the packed-capacity assert never fires, and output
+    stays identical."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(37)
+    prompts = [rs.randint(1, vocab, int(n)) for n in (50, 8, 60, 6)]
+    new = [10, 16, 8, 14]
+    kw = dict(max_batch_size=4, block_size=8, num_blocks=64,
+              max_model_len=128, prefix_cache=True,
+              prefill_chunk_tokens=8, prefill_token_budget=8)
+    plain, _ = _serve(llama_engine, prompts, new, **kw)
+    spec, srv = _serve(llama_engine, prompts, new, spec_tokens=8, **kw)
+    assert spec == plain
+    assert all(s == "finished" for s, _, _ in spec)
+    assert srv.compile_counts == {"mixed_step": 1}
+
+
+# ---------------------------------------------------------------------
+# bucketed packed widths (satellite)
+# ---------------------------------------------------------------------
+
+def test_bucketed_widths_identity_and_bounded_compiles(llama_engine):
+    """mixed_step_buckets: token identity with the default full-width
+    engine, compile count bounded by the bucket set, per-bucket
+    fingerprints keeping the sentinel silent, and a decode-only phase
+    actually dispatching a NARROW bucket."""
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(41)
+    prompts = [rs.randint(1, vocab, int(n)) for n in (40, 6, 9, 12)]
+    new = [8, 24, 20, 16]
+    kw = dict(max_batch_size=4, block_size=8, num_blocks=64,
+              max_model_len=128, prefix_cache=True,
+              prefill_chunk_tokens=8, prefill_token_budget=16)
+    plain, srv_p = _serve(llama_engine, prompts, new, **kw)
+    bucketed, srv_b = _serve(llama_engine, prompts, new,
+                             mixed_step_buckets=True, **kw)
+    assert bucketed == plain
+    widths = srv_b.mixed_step_widths
+    assert widths[-1] == srv_p.mixed_step_tokens and len(widths) >= 2
+    assert srv_b.compile_counts["mixed_step"] <= len(widths)
+    assert srv_b.perf.recompile_total == 0
+    compiled = [n for n in srv_b.perf.programs.programs
+                if n.startswith("mixed_step[")]
+    # the decode-only tail of the run (prompts fully prefilled, 4 decode
+    # rows) must fit — and dispatch — the narrowest bucket
+    assert f"mixed_step[{widths[0]}]" in compiled, compiled
+    # default engine keeps the single unbucketed program name
+    assert "mixed_step" in srv_p.perf.programs.programs
+
+
+def test_bucketed_widths_with_speculation(llama_engine):
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(43)
+    prompts = [rs.randint(1, vocab, int(n)) for n in (10, 7)]
+    new = [18, 22]
+    kw = dict(max_batch_size=2, block_size=8, num_blocks=48,
+              max_model_len=128, prefix_cache=True)
+    plain, _ = _serve(llama_engine, prompts, new, **kw)
+    spec, srv = _serve(llama_engine, prompts, new, spec_tokens=6,
+                       mixed_step_buckets=True, **kw)
+    assert spec == plain
+    assert srv.compile_counts["mixed_step"] <= len(srv.mixed_step_widths)
+    assert srv.perf.recompile_total == 0
+
+
+# ---------------------------------------------------------------------
+# status / reporting
+# ---------------------------------------------------------------------
+
+def test_speculation_status_and_report(llama_engine, capsys):
+    vocab = llama_engine.module.config.vocab_size
+    rs = np.random.RandomState(47)
+    _, srv = _serve(llama_engine, [rs.randint(1, vocab, 10)], [16],
+                    spec_tokens=4, max_batch_size=2, block_size=8,
+                    num_blocks=32, max_model_len=64)
+    st = srv.speculation_status()
+    assert st["enabled"] and st["drafter"] == "prompt_lookup"
+    assert st["spec_tokens"] == 4
+    assert st["drafted"] == srv.metrics.spec_drafted
+    assert 0.0 <= st["accept_rate"] <= 1.0
+    # ds_report's speculation section prints the live engine's status
+    # next to the compiled-program table
+    from deepspeed_tpu.env_report import speculation_report
+
+    speculation_report()
+    out = capsys.readouterr().out
+    assert "prompt_lookup" in out and "accept" in out
+
+    # an engine without speculation reports disabled, not garbage
+    srv2 = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=32, max_model_len=64))
+    assert srv2.speculation_status()["enabled"] is False

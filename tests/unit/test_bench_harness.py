@@ -6,6 +6,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -28,6 +29,62 @@ def test_bench_tiny_emits_json():
     rec = _last_json(r.stdout)
     assert rec["metric"] == "llama400m_train_tflops_per_chip"
     assert rec["value"] is not None and rec["value"] > 0
+
+
+def test_bench_aborts_on_stray_bench_process():
+    """Pre-flight stray guard: with another live 'bench.py' process on
+    the box (here: a sleep wearing bench.py as argv[0] — the shape the
+    PR 8 leaked-grandchild incident had), bench.py must refuse to time
+    anything and emit an error JSON naming the PID, instead of silently
+    producing contended numbers. DS_BENCH_IGNORE_STRAYS=1 overrides."""
+    stray = subprocess.Popen(["bench.py", "60"], executable="/bin/sleep")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env={**os.environ, "DS_BENCH_TINY": "1"},
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        rec = _last_json(r.stdout)
+        assert rec["value"] is None
+        assert "stray" in rec["error"] and str(stray.pid) in rec["error"]
+        assert "ladder" not in (rec.get("detail") or {}), \
+            "no candidate may run once the guard fired"
+    finally:
+        stray.kill()
+        stray.wait()
+
+
+def test_stray_scan_detects_strays_not_self_or_editors(monkeypatch, tmp_path):
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+
+    me, parent = os.getpid(), os.getppid()
+    # an idle "editor" whose cmdline merely NAMES bench.py (argv0 vim,
+    # bench.py a later arg — the sh $0 slot) is NOT contention
+    editor = subprocess.Popen(["vim", "-c", "sleep 600", "bench.py"],
+                              executable="/bin/sh")
+    # a real leaked shape: a python interpreter EXECUTING a bench.py
+    fake = tmp_path / "bench.py"
+    fake.write_text("import time; time.sleep(600)\n")
+    stray = subprocess.Popen([sys.executable, str(fake)])
+    try:
+        # wait out the fork->exec window: until exec lands, the child's
+        # /proc cmdline does not yet carry bench.py
+        deadline = time.time() + 10
+        pids = set()
+        while time.time() < deadline and stray.pid not in pids:
+            pids = {pid for pid, _ in bench.stray_bench_processes()}
+            if stray.pid not in pids:
+                time.sleep(0.05)
+        assert stray.pid in pids, "an executing bench.py must be detected"
+        assert editor.pid not in pids, \
+            "an editor merely naming bench.py must not abort timing runs"
+        assert me not in pids and parent not in pids, \
+            "the scan must exclude the calling process and its ancestors"
+    finally:
+        for p in (editor, stray):
+            p.kill()
+            p.wait()
 
 
 @pytest.mark.slow

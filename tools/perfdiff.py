@@ -61,7 +61,12 @@ ABS_BARS = {"overhead_pct": 5.0, "admin_overhead_pct": 1.0}
 
 HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
                     "mfu", "mbu", "bandwidth", "gbps", "tflops",
-                    "cached_tokens")
+                    "cached_tokens",
+                    # speculative decoding (r12): on the SAME workload a
+                    # dropping accept rate or tokens-per-verify-step is a
+                    # drafting/acceptance regression (decode_tokens_per_sec
+                    # and *_speedup already match the rules above)
+                    "accept_rate", "spec_tokens_per_verify")
 
 LOWER_IS_BETTER = ("ttft", "latency", "wall", "overhead", "shed_rate",
                    "timeout_rate", "step_p", "evictions")
@@ -78,7 +83,13 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         "counters.", "by_state.", "offered", "queue_depth_cap", "deadline_s",
         "perf.peak_", "perf.n_devices", "hbm_", "tokens_per_sec_per_chip",
         "perf.mixed_step_mfu", "perf.mixed_step_mbu", "perf.decode_mfu",
-        "perf.decode_mbu")
+        "perf.decode_mbu",
+        # spec-sweep bookkeeping (r12): drafted/accepted/pages-dropped are
+        # workload-volume counters (the gated signals are accept_rate,
+        # spec_tokens_per_verify and the speedups), and spec_tokens/widths
+        # are configuration, not measurements
+        "spec_sweep.spec_tokens", "drafted", "accepted", "pages_dropped",
+        ".widths.")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
