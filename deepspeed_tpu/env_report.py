@@ -179,6 +179,31 @@ def comm_report() -> None:
               f"{h.percentile(0.95) * 1e6:>9.1f}u")
 
 
+def dslint_report() -> None:
+    """dslint static-analysis status: rule count, baseline size,
+    ignore-pragma count, and a fresh-run verdict over the installed
+    package (the gate itself lives in ``tools/dslint.py`` / tier-1; this
+    section makes an incident doc say whether the tree it ran from was
+    clean). Pure AST — no accelerator, well under a second."""
+    import deepspeed_tpu
+    from deepspeed_tpu.utils.lint_rules import lint_status
+
+    pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+    baseline = os.path.join(os.path.dirname(pkg), "tools",
+                            "dslint_baseline.json")
+    try:
+        st = lint_status(pkg, baseline_path=baseline
+                         if os.path.exists(baseline) else None)
+    except Exception as e:  # a broken linter must not break ds_report
+        print(f"dslint: unavailable ({type(e).__name__}: {e})")
+        return
+    badge = GREEN_OK if st["findings"] == 0 else RED_NO
+    print(f"dslint: {badge} {st['verdict']} — {st['rules']} rules over "
+          f"{st['files']} files; baseline {st['baseline_entries']} "
+          f"entr(ies) ({st['baselined']} matched), "
+          f"{st['ignore_pragmas']} ignore pragma(s) in tree")
+
+
 def perf_report() -> None:
     """Performance-accounting status (``monitor/perf.py``): per-device
     memory stats and the resident compiled-program table (name,
@@ -305,6 +330,7 @@ def main(argv=None):
     fault_report()
     trace_report()
     admin_report()
+    dslint_report()
     perf_report()
     speculation_report()
     comm_report()

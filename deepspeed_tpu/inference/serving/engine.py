@@ -98,13 +98,19 @@ class StepWatchdogTimeout(RuntimeError):
 
 #: live engines in this process (weak — a dropped engine vanishes);
 #: ``ds_report`` reads speculation status from here, next to the
-#: compiled-program table that is per-process for the same reason
-_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+#: compiled-program table that is per-process for the same reason.
+#: The lock mirrors ``monitor/perf.py``'s ``_live_registries`` pattern:
+#: WeakSet iteration runs Python-level bytecode, so ``list(ws)`` on the
+#: report thread races an ``add`` from a thread constructing an engine
+#: (``RuntimeError: Set changed size during iteration``).
+_live_engines_lock = threading.Lock()
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()  # dslint: guarded-by=_live_engines_lock
 
 
 def live_serving_engines() -> List["ServingEngine"]:
     """Strong refs to every live ServingEngine in this process."""
-    return list(_LIVE_ENGINES)
+    with _live_engines_lock:
+        return list(_LIVE_ENGINES)
 
 
 @dataclasses.dataclass
@@ -409,8 +415,9 @@ class ServingEngine:
         #: XLA compiles of each program kind. The unified engine has ONE
         #: resident program; the legacy keys exist only in legacy mode (a
         #: retired ``chunked_prefill`` entry must read as gone, not as 0)
-        self.compile_counts = {"mixed_step": 0} if self._mixed else \
-            {"decode": 0, "prefill": 0, "chunked_prefill": 0}
+        self.compile_counts = (  # dslint: guarded-by=snapshot
+            {"mixed_step": 0} if self._mixed
+            else {"decode": 0, "prefill": 0, "chunked_prefill": 0})
         #: first mixed/decode/chunked-prefill call carries the XLA compile
         #: and is never watchdog-judged (heartbeat.py's first-beat rule).
         #: With bucketed widths each bucket's first call carries its OWN
@@ -421,8 +428,10 @@ class ServingEngine:
         self._decode_warm = False
         self._chunked_warm = False
         #: the one abandoned watchdog thread, if still wedged in device
-        #: compute — bounds thread growth to 1 under a persistent hang
-        self._wedged: Optional[threading.Thread] = None
+        #: compute — bounds thread growth to 1 under a persistent hang.
+        #: Written only by the engine thread; the /healthz probe thread
+        #: reads it, so probe-side reads must snapshot to a local first
+        self._wedged: Optional[threading.Thread] = None  # dslint: guarded-by=snapshot
         #: incident recency for the /healthz probe (perf_counter stamps;
         #: None = never happened)
         self._last_trip_time: Optional[float] = None
@@ -442,7 +451,8 @@ class ServingEngine:
         # updates; the price is one pool copy per step.
         self._donate = (1,) if jax.default_backend() != "cpu" \
             and not cfg.step_watchdog_s else ()
-        _LIVE_ENGINES.add(self)
+        with _live_engines_lock:
+            _LIVE_ENGINES.add(self)
         log_dist(f"ServingEngine: slots={B}, pool={cfg.num_blocks}x"
                  f"{cfg.block_size} ({kv_dtype.__name__ if hasattr(kv_dtype, '__name__') else kv_dtype}), "
                  f"max_len={cfg.max_model_len}"
@@ -762,7 +772,12 @@ class ServingEngine:
         state a router should route around). Detail carries incident
         recency (last watchdog trip / quarantine age) for dashboards."""
         now = time.perf_counter()
-        wedged = self._wedged is not None and self._wedged.is_alive()
+        # snapshot before use: this runs on the admin server's probe
+        # thread while the engine thread may clear _wedged between the
+        # None check and the is_alive() call (AttributeError -> a 500
+        # from the very probe that promises 200-or-503)
+        w = self._wedged
+        wedged = w is not None and w.is_alive()
         detail: Dict[str, Any] = {
             "wedged": wedged,
             "steps": self.metrics.steps,
@@ -941,7 +956,8 @@ class ServingEngine:
         # 4. the single ragged decode step over all slots, watchdog-bounded
         active = [(s, r) for s, r in self.sched.active()
                   if r.state is RequestState.RUNNING and not r.prefilling]
-        if active and self._wedged is not None and self._wedged.is_alive():
+        w = self._wedged  # snapshot (the _wedged read-once discipline)
+        if active and w is not None and w.is_alive():
             # a prefill chunk tripped the watchdog THIS step: nothing else
             # may touch the backend until the abandoned call clears (the
             # step-top gate only covers trips from earlier steps)
@@ -2145,7 +2161,7 @@ class ServingEngine:
                        row_start, row_len, chunk_start, context_len,
                        corrupt, rng):
             # trace-time side effect: runs once per XLA compile
-            self.compile_counts["mixed_step"] += 1
+            self.compile_counts["mixed_step"] += 1  # dslint: ignore[trace-closure-state] intentional trace-time compile counter (fires once per XLA compile)
             self.perf.note_compile(name)
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": name})
@@ -2180,7 +2196,7 @@ class ServingEngine:
 
         def decode(params, pool, tables, seq_lens, last_tok, corrupt, rng):
             # trace-time side effect: runs once per XLA compile
-            self.compile_counts["decode"] += 1
+            self.compile_counts["decode"] += 1  # dslint: ignore[trace-closure-state] intentional trace-time compile counter (fires once per XLA compile)
             self.perf.note_compile("decode")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "decode"})
@@ -2213,7 +2229,7 @@ class ServingEngine:
         module, scfg = self.engine.module, self.config
 
         def prefill(params, pool, table_row, ids, length, rng):
-            self.compile_counts["prefill"] += 1
+            self.compile_counts["prefill"] += 1  # dslint: ignore[trace-closure-state] intentional trace-time compile counter (fires once per XLA compile)
             self.perf.note_compile(f"prefill[{t_bucket}]")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "prefill", "bucket": t_bucket})
@@ -2251,7 +2267,7 @@ class ServingEngine:
 
         def chunked_prefill(params, pool, table_row, ids, start, length,
                             corrupt, rng):
-            self.compile_counts["chunked_prefill"] += 1
+            self.compile_counts["chunked_prefill"] += 1  # dslint: ignore[trace-closure-state] intentional trace-time compile counter (fires once per XLA compile)
             self.perf.note_compile("chunked_prefill")
             self.tracer.instant("xla_compile", cat="engine",
                                 args={"kind": "chunked_prefill"})

@@ -180,8 +180,9 @@ class ServingMetrics:
             "step_s", lo=1e-5, hi=4e3)
         #: rolling SLO window: 1 per non-good terminal, 0 per good — the
         #: burn-rate gauge is its mean (bounded memory, recovers as good
-        #: traffic pushes bad verdicts out)
-        self.slo_window: Deque[int] = deque(maxlen=SLO_WINDOW)
+        #: traffic pushes bad verdicts out). The /metrics scrape thread
+        #: reads it mid-append, so readers take one list() snapshot
+        self.slo_window: Deque[int] = deque(maxlen=SLO_WINDOW)  # dslint: guarded-by=snapshot
 
     def record_ttft(self, x: float) -> None:
         self.ttft_hist.observe(x)
@@ -266,9 +267,23 @@ class ServingMetrics:
         """Fraction of the last ``SLO_WINDOW`` terminal requests that
         did NOT meet their SLO (misses + sheds + failures). 0 with no
         terminals yet — an idle replica is not burning budget."""
-        if not self.slo_window:
+        # ONE point-in-time copy: this runs on the /metrics scrape
+        # thread while the engine appends verdicts — summing the live
+        # deque and then len()-ing it again reads two different windows
+        # (a burn rate over a denominator the numerator never saw).
+        # Retry the copy itself: a deque iterator raises RuntimeError on
+        # ANY concurrent mutation (maxlen rotation included), and the
+        # list() walk can be preempted mid-allocation; verdict appends
+        # per scrape are finite, so this converges immediately
+        while True:
+            try:
+                window = list(self.slo_window)
+                break
+            except RuntimeError:
+                continue
+        if not window:
             return 0.0
-        return sum(self.slo_window) / len(self.slo_window)
+        return sum(window) / len(window)
 
     def snapshot(self) -> Dict[str, float]:
         out = {

@@ -50,7 +50,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..utils.logging import log_dist, logger
-from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       snapshot_items)
 
 #: quantile legs a Histogram renders as a Prometheus summary
 SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
@@ -226,8 +227,8 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None,
 
 #: every live AdminServer in the process, for ``ds_report`` (weak refs: a
 #: status report must never pin a closed server or its engine)
-_live_servers: "weakref.WeakSet[AdminServer]" = weakref.WeakSet()
 _live_lock = threading.Lock()
+_live_servers: "weakref.WeakSet[AdminServer]" = weakref.WeakSet()  # dslint: guarded-by=_live_lock
 
 
 def live_admin_servers() -> List["AdminServer"]:
@@ -361,7 +362,7 @@ class AdminServer:
         path = parsed.path.rstrip("/") or "/"
         if path == "/metrics":
             body = self.metrics_fn() if self.metrics_fn is not None else ""
-            self.last_scrape_time = time.time()
+            self.last_scrape_time = time.time()  # dslint: ignore[determinism] ds_report compares this against wall time; human-facing recency, not a span clock
             self.scrape_count += 1
             self._send(handler, 200, PROMETHEUS_CONTENT_TYPE, body)
         elif path == "/healthz":
@@ -434,7 +435,9 @@ def serving_metrics_text(srv) -> str:
     histograms when shared) plus the serving snapshot scalars and the
     per-program compile counts as labeled counters."""
     scalars: Dict[str, float] = dict(srv.metrics.snapshot())
-    for prog, n in srv.compile_counts.items():
+    # whole-snapshot first: this renders on the scrape thread while the
+    # engine owns compile_counts (the guarded-by=snapshot law)
+    for prog, n in snapshot_items(srv.compile_counts):
         scalars[f"compile_count{{program={prog}}}"] = float(n)
     return render_prometheus(registry=srv.metrics.registry, scalars=scalars)
 

@@ -43,6 +43,7 @@ import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
+from .registry import snapshot_items
 
 # ---------------------------------------------------------------------------
 # device capability table (per chip)
@@ -180,8 +181,8 @@ class CompiledProgram:
 
 #: every live ProgramRegistry in the process, for ``ds_report``'s resident
 #: compiled-program table (weak: the report must never pin a dropped engine)
-_live_registries: "weakref.WeakSet[ProgramRegistry]" = weakref.WeakSet()
 _live_lock = threading.Lock()
+_live_registries: "weakref.WeakSet[ProgramRegistry]" = weakref.WeakSet()  # dslint: guarded-by=_live_lock
 
 
 class ProgramRegistry:
@@ -192,14 +193,20 @@ class ProgramRegistry:
         self.scope = scope
         self.tracer = tracer
         self.metrics = metrics  # MetricsRegistry for the alarm counters
-        self.programs: Dict[str, CompiledProgram] = {}
+        self._lock = threading.Lock()
+        #: keys arrive at runtime (per-bucket programs) while /statusz
+        #: reads off-thread; get-or-create and snapshots both lock (one
+        #: uncontended acquire per dispatch — noise against the
+        #: fingerprint compare the dispatch already pays)
+        self.programs: Dict[str, CompiledProgram] = {}  # dslint: guarded-by=_lock
         with _live_lock:
             _live_registries.add(self)
 
     def program(self, name: str) -> CompiledProgram:
-        prog = self.programs.get(name)
-        if prog is None:
-            prog = self.programs[name] = CompiledProgram(name)
+        with self._lock:
+            prog = self.programs.get(name)
+            if prog is None:
+                prog = self.programs[name] = CompiledProgram(name)
         return prog
 
     def note_compile(self, name: str) -> None:
@@ -253,15 +260,21 @@ class ProgramRegistry:
 
     @property
     def recompile_total(self) -> int:
-        # list() first: the admin server's /statusz thread reads this
-        # while the engine may be registering a program — a Python-level
-        # genexpr over a live values() view raises on concurrent insert
-        # (list(dict.values()) is GIL-atomic; the view iteration is not)
-        return sum(p.recompiles for p in list(self.programs.values()))
+        # snapshot under the lock: the admin server's /statusz thread
+        # reads this while the engine may be registering a program —
+        # walking a live view across the insert raises RuntimeError
+        with self._lock:
+            progs = list(self.programs.values())
+        return sum(p.recompiles for p in progs)
 
     def table(self) -> List[Dict[str, Any]]:
+        # same law as recompile_total: /statusz calls this from the
+        # admin thread while the engine registers the next bucket's
+        # program — snapshot whole under the lock, then sort the copy
+        with self._lock:
+            items = list(self.programs.items())
         rows = []
-        for name, prog in sorted(self.programs.items()):
+        for name, prog in sorted(items):
             row = prog.row()
             if self.scope:
                 row["name"] = f"{self.scope}/{name}"
@@ -477,10 +490,11 @@ class PerfAccounting:
         self.programs = ProgramRegistry(tracer=tracer, metrics=metrics,
                                         scope=scope)
         self._spec_memo: Dict[str, Tuple[int, str]] = {}
+        #: per-step utilization entries keyed by program name — keys
+        #: arrive at runtime and /statusz reads off-thread (list() law)
+        self.last: Dict[str, Dict[str, Optional[float]]] = {}  # dslint: guarded-by=snapshot
         #: None = unprobed, False = backend has no allocator stats
         self._mem_capable: Optional[bool] = None
-        #: last on_program_step utilization values, per program
-        self.last: Dict[str, Dict[str, Optional[float]]] = {}
 
     # -- fingerprints ---------------------------------------------------
 
@@ -591,7 +605,8 @@ class PerfAccounting:
             "hbm_bytes_in_use": live,
             "hbm_peak_bytes": peak,
             "programs": self.programs.table(),
-            # list() first — /statusz reads this off-thread while the
-            # engine publishes per-step utilization entries
-            "utilization": {k: dict(v) for k, v in list(self.last.items())},
+            # whole-snapshot first — /statusz reads this off-thread
+            # while the engine publishes per-step utilization entries
+            "utilization": {k: dict(v)
+                            for k, v in snapshot_items(self.last)},
         }

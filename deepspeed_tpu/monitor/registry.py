@@ -19,6 +19,7 @@ the normal contract for monitoring counters.
 """
 
 import math
+import threading
 from typing import Any, Dict, List, Optional
 
 from .monitor import Event, events_from_scalars
@@ -117,6 +118,26 @@ class Histogram:
         return self.sum / self.count if self.count else None
 
 
+def snapshot_items(mapping) -> List[Any]:
+    """Point-in-time items of a SINGLE-WRITER, bounded-churn dict that a
+    probe thread reads (``compile_counts``, per-program utilization):
+    key inserts are rare and eventually stop, so the retry converges.
+
+    ``list(d.items())`` alone is NOT safe: it materializes in one C
+    call but allocates a 2-tuple per item, and an allocation-triggered
+    pause can let the writing thread run mid-walk — under insert
+    pressure the walk raises ``RuntimeError: dictionary changed size
+    during iteration`` (observed on CPython 3.10 by the perf-table
+    hammer test, which is why the hot multi-access registries below
+    take a REAL lock instead: under sustained adversarial churn no
+    lock-free retry converges)."""
+    while True:
+        try:
+            return list(dict(mapping).items())
+        except RuntimeError:
+            continue
+
+
 def _key(name: str, labels: Dict[str, Any]) -> str:
     if not labels:
         return name
@@ -135,14 +156,18 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        #: labeled metrics arrive at runtime while the scrape thread
+        #: renders /metrics; get-or-create and snapshot both lock
+        self._metrics: Dict[str, Any] = {}  # dslint: guarded-by=_lock
 
     def _get(self, name: str, labels: Dict[str, Any], factory, kind):
         key = _key(name, labels)
-        m = self._metrics.get(key)
-        if m is None:
-            m = self._metrics[key] = factory()
-        elif not isinstance(m, kind):
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = factory()
+        if not isinstance(m, kind):
             raise TypeError(f"metric {key!r} already registered as "
                             f"{type(m).__name__}, not {kind.__name__}")
         return m
@@ -167,13 +192,13 @@ class MetricsRegistry:
                 f"conflicting (lo={lo}, hi={hi}, growth={growth})")
         return h
 
-    def items(self):
+    def items(self):  # dslint: snapshot
         # a point-in-time LIST, not a live view: the admin server's
-        # /metrics renderer iterates from its own thread while the engine
-        # may be get-or-creating metrics — iterating a live dict view
-        # across an insert raises RuntimeError (list(dict.items()) is
-        # GIL-atomic; a live view is not)
-        return list(self._metrics.items())
+        # /metrics renderer iterates from its own thread while the
+        # engine may be get-or-creating metrics — iterating a live dict
+        # view across an insert raises RuntimeError
+        with self._lock:
+            return list(self._metrics.items())
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {}

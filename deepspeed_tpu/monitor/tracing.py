@@ -143,8 +143,9 @@ class Tracer:
         self.capacity = capacity
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._ring: List[Optional[Dict[str, Any]]] = [None] * capacity
-        self._count = 0  # monotone: total events ever appended
+        self._ring: List[Optional[Dict[str, Any]]] = [None] * capacity  # dslint: guarded-by=_lock
+        #: monotone: total events ever appended
+        self._count = 0  # dslint: guarded-by=_lock
 
     # -- emission ------------------------------------------------------
 
@@ -192,10 +193,12 @@ class Tracer:
     @property
     def dropped(self) -> int:
         """Events overwritten by ring wrap-around (bounded-memory proof)."""
-        return max(0, self._count - self.capacity)
+        with self._lock:
+            return max(0, self._count - self.capacity)
 
     def __len__(self) -> int:
-        return min(self._count, self.capacity)
+        with self._lock:
+            return min(self._count, self.capacity)
 
     def events(self) -> List[Dict[str, Any]]:
         """Ring snapshot, oldest kept event first."""
@@ -256,8 +259,8 @@ def dump_seq() -> int:
 #: global recorder next to an engine's own) must produce ONE post-mortem
 #: per firing, not one per recorder. Weak refs: holding an armed-dir slot
 #: never keeps a dropped engine alive.
-_fault_armed_dirs: Dict[str, "weakref.ref[FlightRecorder]"] = {}
 _arm_lock = threading.Lock()
+_fault_armed_dirs: Dict[str, "weakref.ref[FlightRecorder]"] = {}  # dslint: guarded-by=_arm_lock
 
 
 class FlightRecorder:
@@ -307,7 +310,7 @@ class FlightRecorder:
             os.makedirs(self.out_dir, exist_ok=True)
             header = {"kind": "flight_recorder", "trigger": trigger,
                       "detail": dict(detail or {}),
-                      "wall_time": time.time(),
+                      "wall_time": time.time(),  # dslint: ignore[determinism] post-mortem header wants the wall clock of record; spans stay on perf_counter
                       "monotonic_us": time.perf_counter() * 1e6,
                       "pid": os.getpid(), "events": len(events),
                       "events_dropped": self.tracer.dropped,

@@ -1,0 +1,61 @@
+"""dslint: repo-specific static analysis (``tools/dslint.py`` front end).
+
+Every hard-won invariant of the serving/runtime stack — one resident
+compile, descriptors-as-data, pages released through ``Scheduler._release``,
+watchdog and scrape threads touching only snapshots — is enforced at
+RUNTIME (recompile sentinel, chaos drills, ``check_consistent``), which
+means a violation costs a TPU window or a production incident to discover.
+This package is the review-time half: an AST pass whose rule families each
+front-run one of those runtime tripwires, so the class of bug is rejected
+in CI before it ever reaches a device.
+
+Rule families (see ``docs/static-analysis.md`` for the full catalog):
+
+- **trace-safety** — inside functions dispatched as resident jitted
+  programs (the ones wrapped in ``jax.jit``): Python control flow on
+  tracer values, host casts (``int()``/``.item()``), closure over mutable
+  engine state, shape-dependent Python loops. Front-runs the recompile
+  sentinel and ``TracerArrayConversionError`` at dispatch time.
+- **host-sync** — ``np.asarray`` / ``jax.device_get`` /
+  ``.block_until_ready()`` in the serving hot path outside the declared
+  one-sync-per-step harvest sites. Front-runs a silent tokens/sec
+  regression no test asserts on.
+- **lock-discipline** — fields annotated ``guarded-by=<lock>`` may only
+  be touched under that lock; fields annotated ``guarded-by=snapshot``
+  may only be iterated through an immediate ``list()``-style
+  materialization and never read twice in one statement. Front-runs the
+  PR 8 live-dict-during-scrape ``RuntimeError`` class.
+- **terminal-path** — terminal ``Request.state`` writes only inside
+  ``Scheduler._release``; page acquires inside a ``try`` need a release
+  on the exception edge. Front-runs the chaos-suite page-leak invariant.
+- **determinism** — no ``time.time`` / ``random`` / ``np.random`` in
+  serving/monitor code, where ``perf_counter`` and seeded jax streams are
+  the law. Front-runs non-reproducible traces and fingerprint drift.
+
+Exemptions are explicit: ``# dslint: ignore[rule] <reason>`` (a missing
+reason is itself a finding), plus a committed baseline file for
+grandfathered findings so the gate is zero-new-findings from day one.
+"""
+
+from .core import (Finding, LintReport, RULES, load_baseline, run_lint,
+                   write_baseline)
+
+__all__ = ["Finding", "LintReport", "RULES", "run_lint", "load_baseline",
+           "write_baseline", "lint_status"]
+
+
+def lint_status(root, baseline_path=None):
+    """Status block for ``ds_report``: rule count, baseline size,
+    ignore-pragma count, and the verdict of a fresh run over ``root``."""
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    report = run_lint([root], baseline=baseline)
+    return {
+        "rules": len(RULES),
+        "files": report.files,
+        "baseline_entries": len(baseline),
+        "baselined": len(report.baselined),
+        "ignore_pragmas": report.pragma_count,
+        "findings": len(report.findings),
+        "verdict": "clean" if not report.findings
+        else f"{len(report.findings)} finding(s)",
+    }

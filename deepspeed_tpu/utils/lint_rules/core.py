@@ -1,0 +1,443 @@
+"""dslint core: findings, pragmas, annotations, baseline, runner.
+
+The linter is pure AST + tokenize — it never imports the code it checks,
+so it runs in well under a second over the whole package and needs no
+accelerator (tier-1 runs it as an ordinary test).
+
+Three comment vocabularies drive it (all ``# dslint:`` prefixed, so one
+grep finds every exemption in the tree):
+
+- ``# dslint: ignore[rule] <reason>`` — suppress ``rule`` on this
+  statement (same line or the line above). The reason is REQUIRED: an
+  exemption nobody can explain is a finding (``bad-pragma``), not an
+  exemption.
+- ``# dslint: guarded-by=<lock>`` — trailing annotation on a field (or
+  module-global) assignment: every other touch of that field must sit
+  inside ``with self.<lock>:`` (or ``with <lock>:`` for globals). The
+  special value ``snapshot`` declares GIL-snapshot discipline instead:
+  the field may be mutated with single-key operations, but ITERATING it
+  requires an immediate ``list()``-style materialization, and reading it
+  twice in one statement (the classic probe-thread TOCTOU) is rejected.
+- ``# dslint: snapshot`` — on a ``def`` line: the method is a declared
+  snapshot accessor; lock-discipline checks are skipped inside it (it is
+  the blessed place where the copy is taken).
+
+The baseline file grandfathers pre-existing findings so the gate is
+zero-NEW-findings from day one: entries match on ``(path, rule,
+snippet)`` — not line numbers, which drift with every edit — and each
+entry forgives exactly one occurrence.
+"""
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+#: rule catalog: id -> (family, what it flags, fix hint, the runtime
+#: tripwire it front-runs). ``tools/dslint.py --list-rules`` and
+#: ``docs/static-analysis.md`` both render from here, so the catalog
+#: cannot fork from the implementation.
+RULES: Dict[str, Dict[str, str]] = {
+    "trace-branch": {
+        "family": "trace-safety",
+        "what": "Python if/while on a tracer value inside a jitted "
+                "function",
+        "hint": "use jnp.where/lax.cond/lax.select; Python control flow "
+                "on tracers raises TracerBoolConversionError at trace "
+                "time or silently bakes one branch into the compile",
+        "counterpart": "recompile sentinel / trace-time crash",
+    },
+    "trace-host-cast": {
+        "family": "trace-safety",
+        "what": "int()/float()/bool()/.item() on a tracer inside a "
+                "jitted function",
+        "hint": "keep the value on device (astype / jnp ops); a host "
+                "cast forces a blocking device sync per call or fails "
+                "to trace",
+        "counterpart": "host-sync stall the profiler would show",
+    },
+    "trace-closure-state": {
+        "family": "trace-safety",
+        "what": "write to closed-over (engine) state inside a jitted "
+                "function body",
+        "hint": "trace-time side effects run once per XLA compile, not "
+                "per call — if that is the point (compile counters), say "
+                "so with an ignore pragma; otherwise pass state as an "
+                "argument",
+        "counterpart": "compile_counts trace-time counter discipline",
+    },
+    "trace-shape-arith": {
+        "family": "trace-safety",
+        "what": "Python loop bounded by a traced argument's shape/len "
+                "inside a jitted function",
+        "hint": "the loop unrolls per shape, so every new shape is a new "
+                "executable — hoist the bound to a static or use "
+                "lax.fori_loop/scan",
+        "counterpart": "recompile sentinel (fingerprint change)",
+    },
+    "host-sync": {
+        "family": "host-sync",
+        "what": "np.asarray / jax.device_get / .block_until_ready in the "
+                "serving hot path outside the declared harvest sites",
+        "hint": "the serving step syncs the device exactly once, at "
+                "harvest; add the sync to an allowlisted site or keep "
+                "the value on device",
+        "counterpart": "tokens/sec regression no assertion catches",
+    },
+    "lock-guarded": {
+        "family": "lock-discipline",
+        "what": "access to a guarded-by=<lock> field outside `with "
+                "<lock>:`",
+        "hint": "take the declared lock, or mark the accessor `# dslint: "
+                "snapshot` if it copies under the lock",
+        "counterpart": "torn ring/registry state under a probe thread",
+    },
+    "lock-snapshot": {
+        "family": "lock-discipline",
+        "what": "iteration over (or double-read of) a guarded-by=snapshot "
+                "field without materializing a point-in-time copy",
+        "hint": "wrap the view in list()/dict() first (GIL-atomic), or "
+                "read the field once into a local — a live view iterated "
+                "across another thread's insert raises RuntimeError",
+        "counterpart": "PR 8 live-dict-during-scrape RuntimeError",
+    },
+    "terminal-write": {
+        "family": "terminal-path",
+        "what": "terminal Request.state / finish_* bookkeeping written "
+                "outside Scheduler._release",
+        "hint": "call finish/fail/timeout/cancel — every terminal "
+                "transition must funnel through _release so pages always "
+                "return to the pool and the SLO hook sees the request",
+        "counterpart": "chaos-suite page-leak invariant",
+    },
+    "acquire-release": {
+        "family": "terminal-path",
+        "what": "page acquire (allocate/acquire/cow) inside a try whose "
+                "handlers never release",
+        "hint": "free the acquired pages in the except/finally edge (or "
+                "re-raise to a caller that funnels through _release)",
+        "counterpart": "BlockPool check_consistent leak detection",
+    },
+    "determinism": {
+        "family": "determinism",
+        "what": "time.time / random.* / np.random.* in serving, monitor "
+                "or jitted code",
+        "hint": "time.perf_counter is the serving clock (monotonic, "
+                "matches every span/deadline stamp); randomness must ride "
+                "the seeded jax PRNG streams",
+        "counterpart": "non-reproducible traces / fingerprint drift",
+    },
+    "bad-pragma": {
+        "family": "pragma",
+        "what": "malformed dslint pragma, unknown rule id, or ignore "
+                "without a reason",
+        "hint": "write `# dslint: ignore[rule-id] <non-empty reason>`",
+        "counterpart": "unexplained exemptions rotting in the tree",
+    },
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str          # normalized (repo-relative when under the package)
+    line: int
+    rule: str
+    message: str
+    func: str = ""     # enclosing def/class chain, for humans
+    snippet: str = ""  # stripped source line — the baseline match key
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.snippet)
+
+    def render(self) -> str:
+        where = f" (in {self.func})" if self.func else ""
+        hint = RULES.get(self.rule, {}).get("hint", "")
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                f"{where}\n    > {self.snippet}\n    hint: {hint}")
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]          # NEW findings (gate on these)
+    baselined: List[Finding]         # matched a baseline entry
+    suppressed: List[Finding]        # silenced by an ignore pragma
+    files: int = 0
+    pragma_count: int = 0            # ignore pragmas seen in the tree
+
+
+def normalize_path(path: str) -> str:
+    """Stable finding path: relative to the package parent when the file
+    lives under a ``deepspeed_tpu`` tree (so the CLI, tests and ds_report
+    agree no matter where they run from), else relative to cwd."""
+    ap = os.path.abspath(path)
+    parts = ap.split(os.sep)
+    if "deepspeed_tpu" in parts:
+        i = parts.index("deepspeed_tpu")
+        return "/".join(parts[i:])
+    try:
+        rel = os.path.relpath(ap)
+    except ValueError:
+        return ap
+    return rel.replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# pragmas + annotations
+# ---------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(r"#\s*dslint:\s*(.*)$")
+_IGNORE_RE = re.compile(r"ignore\[([a-z0-9\-,\s]+)\]\s*(.*)$")
+_GUARD_RE = re.compile(r"guarded-by=([A-Za-z_][A-Za-z0-9_]*)\s*$")
+
+
+@dataclasses.dataclass
+class FilePragmas:
+    #: line -> (rule ids, reason)
+    ignores: Dict[int, Tuple[Set[str], str]] = \
+        dataclasses.field(default_factory=dict)
+    #: line -> lock name ("snapshot" = GIL-snapshot discipline)
+    guards: Dict[int, str] = dataclasses.field(default_factory=dict)
+    #: def-lines declared snapshot accessors
+    snapshots: Set[int] = dataclasses.field(default_factory=set)
+    #: malformed pragmas: (line, text, why)
+    bad: List[Tuple[int, str, str]] = dataclasses.field(default_factory=list)
+
+
+def parse_pragmas(source: str) -> FilePragmas:
+    out = FilePragmas()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = [(i + 1, ln.strip()) for i, ln in
+                    enumerate(source.splitlines()) if "#" in ln]
+    for line, text in comments:
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        body = m.group(1).strip()
+        if body.startswith("ignore"):
+            im = _IGNORE_RE.match(body)
+            if im is None:
+                out.bad.append((line, text,
+                                "malformed ignore (want ignore[rule] "
+                                "reason)"))
+                continue
+            rules = {r.strip() for r in im.group(1).split(",") if r.strip()}
+            reason = im.group(2).strip()
+            unknown = sorted(r for r in rules if r not in RULES)
+            if unknown:
+                out.bad.append((line, text,
+                                f"unknown rule id(s): {', '.join(unknown)}"))
+                continue
+            if not reason:
+                out.bad.append((line, text,
+                                "ignore pragma without a reason — an "
+                                "exemption nobody can explain is a "
+                                "finding"))
+                continue
+            out.ignores[line] = (rules, reason)
+        elif body.startswith("guarded-by"):
+            gm = _GUARD_RE.match(body)
+            if gm is None:
+                out.bad.append((line, text,
+                                "malformed guarded-by (want "
+                                "guarded-by=<lock attr> or "
+                                "guarded-by=snapshot)"))
+                continue
+            out.guards[line] = gm.group(1)
+        elif body == "snapshot" or body.startswith("snapshot "):
+            out.snapshots.add(line)
+        else:
+            out.bad.append((line, text,
+                            f"unknown dslint directive {body.split()[0]!r}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+# ---------------------------------------------------------------------------
+
+class FileCtx:
+    """Parsed file + pragma map + parent links, shared by every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.norm_path = normalize_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.pragmas = parse_pragmas(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def func_chain(self, node: ast.AST) -> str:
+        chain: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                chain.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(chain))
+
+    def enclosing(self, node: ast.AST, kinds) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            ent = self.pragmas.ignores.get(ln)
+            if ent is not None and rule in ent[0]:
+                return True
+        return False
+
+    def finding(self, node_or_line, rule: str, message: str) -> Finding:
+        line = node_or_line if isinstance(node_or_line, int) \
+            else node_or_line.lineno
+        func = "" if isinstance(node_or_line, int) \
+            else self.func_chain(node_or_line)
+        return Finding(path=self.norm_path, line=line, rule=rule,
+                       message=message, func=func,
+                       snippet=self.snippet(line))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> List[Dict[str, str]]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("findings", data) if isinstance(data, dict) else data
+    out = []
+    for e in entries:
+        out.append({"path": e["path"], "rule": e["rule"],
+                    "snippet": e.get("snippet", "")})
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = [{"path": f.path, "rule": f.rule, "snippet": f.snippet,
+                "line": f.line}
+               for f in sorted(findings, key=lambda f: (f.path, f.line))]
+    with open(path, "w") as f:
+        json.dump({"comment": "dslint grandfathered findings — matched by "
+                              "(path, rule, snippet), one occurrence each; "
+                              "shrink this file, never grow it",
+                   "findings": entries}, f, indent=1)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Sequence[Dict[str, str]]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined); each baseline entry forgives
+    exactly one occurrence."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (e["path"], e["rule"], e["snippet"])
+        budget[k] = budget.get(k, 0) + 1
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def run_lint(paths: Sequence[str],
+             baseline: Sequence[Dict[str, str]] = (),
+             select: Optional[Set[str]] = None) -> LintReport:
+    """Lint every ``.py`` under ``paths``. Two passes: first collect the
+    guarded-field annotations from EVERY file (cross-module discipline —
+    the scrape path reads engine fields from monitor code), then run the
+    rule checkers. ``select`` restricts to a subset of rule ids (tests)."""
+    from . import serving_rules, threads, trace_safety
+
+    ctxs: List[FileCtx] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(FileCtx(path, source))
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=normalize_path(path), line=e.lineno or 1,
+                rule="bad-pragma",
+                message=f"file does not parse: {e.msg}", snippet=""))
+        except (OSError, ValueError):
+            continue
+
+    guarded = threads.collect_guarded_fields(ctxs)
+
+    for ctx in ctxs:
+        for line, text, why in ctx.pragmas.bad:
+            findings.append(ctx.finding(line, "bad-pragma", why))
+        findings.extend(trace_safety.check(ctx))
+        findings.extend(threads.check(ctx, guarded))
+        findings.extend(serving_rules.check(ctx))
+
+    if select is not None:
+        findings = [f for f in findings if f.rule in select]
+
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_path = {c.norm_path: c for c in ctxs}
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and f.rule != "bad-pragma" \
+                and ctx.suppressed(f.line, f.rule):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    new, old = apply_baseline(kept, baseline)
+    return LintReport(findings=new, baselined=old, suppressed=suppressed,
+                      files=len(ctxs),
+                      pragma_count=sum(len(c.pragmas.ignores)
+                                       for c in ctxs))

@@ -1,0 +1,300 @@
+"""lock-discipline rules: annotated shared state, checked structurally.
+
+The PR 3/PR 8 law — *watchdog and scrape threads see snapshots, never
+live state* — becomes checkable through two ``guarded-by`` annotations:
+
+- ``# dslint: guarded-by=<lock_attr>`` — classic mutual exclusion: every
+  touch of the field outside ``with self.<lock>:`` (or ``with <lock>:``
+  for module globals) is a finding, unless the accessor is declared
+  ``# dslint: snapshot`` (the blessed copy-taker).
+- ``# dslint: guarded-by=snapshot`` — GIL-snapshot discipline for fields
+  read by probe threads without a lock: single-key operations are fine
+  (one dict/attr op is atomic under the GIL), but ITERATION must go
+  through an immediate ``list()``/``dict()``/``tuple()``/``set()``/
+  ``len()`` materialization (one C call, atomic) — a live view walked by
+  Python-level code across another thread's insert raises RuntimeError —
+  and reading the field twice in one statement (``self._wedged is not
+  None and self._wedged.is_alive()``) is the probe-thread TOCTOU: the
+  second read can see a different value than the first.
+
+Snapshot discipline is enforced CROSS-module by field name: the scrape
+path (monitor/export.py) iterates engine fields it does not declare, and
+the violation lives at the read site, not the declaration.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import FileCtx, Finding
+
+#: one immediate C-level materialization makes a point-in-time copy
+_MATERIALIZERS = {"list", "dict", "tuple", "set", "frozenset", "len"}
+#: builtins that iterate their argument with Python-level stepping (or
+#: whose use on a live view the law forbids regardless)
+_ITERATORS = {"sorted", "sum", "min", "max", "any", "all", "map",
+              "filter", "enumerate", "reversed", "zip"}
+_VIEW_METHODS = {"items", "values", "keys"}
+
+
+@dataclasses.dataclass
+class GuardedFields:
+    #: (path, class name, field) -> lock attr ("snapshot" = GIL discipline)
+    class_fields: Dict[Tuple[str, str, str], str] = \
+        dataclasses.field(default_factory=dict)
+    #: (path, global name) -> lock global
+    module_vars: Dict[Tuple[str, str], str] = \
+        dataclasses.field(default_factory=dict)
+    #: field names under snapshot discipline — enforced EVERYWHERE by name
+    snapshot_names: Set[str] = dataclasses.field(default_factory=set)
+    #: lines holding the annotated declarations (exempt from checks)
+    decl_lines: Dict[str, Set[int]] = \
+        dataclasses.field(default_factory=dict)
+    #: pragmas that bound to NOTHING (path -> [(line, why)]): a guard
+    #: the collector dropped silently would leave a field believed
+    #: protected and never checked — these become bad-pragma findings
+    orphans: Dict[str, list] = dataclasses.field(default_factory=dict)
+
+
+def collect_guarded_fields(ctxs: Sequence[FileCtx]) -> GuardedFields:
+    out = GuardedFields()
+    for ctx in ctxs:
+        decls = out.decl_lines.setdefault(ctx.norm_path, set())
+        orphans = out.orphans.setdefault(ctx.norm_path, [])
+        for line in ctx.pragmas.snapshots:
+            if not _is_def_line(ctx, line):
+                orphans.append((
+                    line,
+                    "`# dslint: snapshot` must sit on the `def` line of "
+                    "the accessor it blesses (nothing is declared here)"))
+        for line, lock in ctx.pragmas.guards.items():
+            node = _assignment_at(ctx, line)
+            if node is None:
+                # a guard that binds to nothing must FAIL the gate, not
+                # silently protect nothing: the natural mistake is
+                # writing it on the line above the assignment (where
+                # ignore pragmas are honored)
+                orphans.append((
+                    line,
+                    f"guarded-by={lock} pragma is not on a field/global "
+                    f"assignment line — the field it meant to guard is "
+                    f"NOT being checked"))
+                continue
+            decls.add(line)
+            target = _first_target(node)
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                cls = ctx.enclosing(node, ast.ClassDef)
+                cls_name = cls.name if cls is not None else ""
+                out.class_fields[(ctx.norm_path, cls_name,
+                                  target.attr)] = lock
+                if lock == "snapshot":
+                    out.snapshot_names.add(target.attr)
+            elif isinstance(target, ast.Name):
+                out.module_vars[(ctx.norm_path, target.id)] = lock
+                if lock == "snapshot":
+                    out.snapshot_names.add(target.id)
+    return out
+
+
+def _assignment_at(ctx: FileCtx, line: int) -> Optional[ast.AST]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                and node.lineno == line:
+            return node
+    return None
+
+
+def _is_def_line(ctx: FileCtx, line: int) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.lineno == line or any(
+                    getattr(d, "lineno", -1) == line
+                    for d in node.decorator_list):
+                return True
+    return False
+
+
+def _own_fields(ctx: FileCtx, cls: ast.ClassDef) -> Set[str]:
+    """Fields a class initializes itself (``self.x = ...`` anywhere in
+    its body) — used to keep snapshot-by-name enforcement off unrelated
+    classes that happen to reuse a guarded field's name."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+def _first_target(node: ast.AST):
+    if isinstance(node, ast.Assign):
+        return node.targets[0]
+    return node.target
+
+
+def _under_lock(ctx: FileCtx, node: ast.AST, lock: str) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>:`` / ``with
+    <lock>:`` (plain or via ``.acquire()``-less context use)?"""
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and e.attr == lock:
+                    return True
+                if isinstance(e, ast.Name) and e.id == lock:
+                    return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def _in_snapshot_method(ctx: FileCtx, node: ast.AST) -> bool:
+    fn = ctx.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    while fn is not None:
+        if fn.lineno in ctx.pragmas.snapshots or any(
+                getattr(d, "lineno", -1) in ctx.pragmas.snapshots
+                for d in fn.decorator_list):
+            return True
+        fn = ctx.enclosing(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return False
+
+
+def _dotted(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+def _nearest_stmt(ctx: FileCtx, node: ast.AST) -> Optional[ast.AST]:
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def check(ctx: FileCtx, guarded: GuardedFields) -> List[Finding]:
+    out: List[Finding] = []
+    decl_lines = guarded.decl_lines.get(ctx.norm_path, set())
+    for line, why in guarded.orphans.get(ctx.norm_path, ()):
+        out.append(ctx.finding(line, "bad-pragma", why))
+
+    # -- lock-guarded: mutual-exclusion fields --------------------------
+    for (path, cls_name, field), lock in guarded.class_fields.items():
+        if path != ctx.norm_path or lock == "snapshot":
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Attribute) and node.attr == field
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            cls = ctx.enclosing(node, ast.ClassDef)
+            if cls is None or cls.name != cls_name:
+                continue
+            if node.lineno in decl_lines:
+                continue
+            if _under_lock(ctx, node, lock) or \
+                    _in_snapshot_method(ctx, node):
+                continue
+            out.append(ctx.finding(
+                node, "lock-guarded",
+                f"self.{field} touched outside `with self.{lock}:` "
+                f"(declared guarded-by={lock})"))
+    for (path, var), lock in guarded.module_vars.items():
+        if path != ctx.norm_path or lock == "snapshot":
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Name) and node.id == var):
+                continue
+            if node.lineno in decl_lines:
+                continue
+            if _under_lock(ctx, node, lock) or \
+                    _in_snapshot_method(ctx, node):
+                continue
+            out.append(ctx.finding(
+                node, "lock-guarded",
+                f"{var} touched outside `with {lock}:` "
+                f"(declared guarded-by={lock})"))
+
+    # -- lock-snapshot: GIL-snapshot fields, by name, everywhere --------
+    if guarded.snapshot_names:
+        own_fields_memo: Dict[int, Set[str]] = {}
+        per_stmt: Dict[Tuple[int, str], List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Attribute)
+                    and node.attr in guarded.snapshot_names
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            if node.lineno in decl_lines or _in_snapshot_method(ctx, node):
+                continue
+            # by-name enforcement must not gate an UNRELATED class that
+            # happens to reuse a guarded field's name (e.g. a private
+            # single-threaded `self.last`): `self.<field>` reads inside
+            # a class that initializes that field itself are that
+            # class's own state — only the ANNOTATED declaring class is
+            # enforced. Non-self roots (`srv.compile_counts`, the
+            # scrape path) are always enforced.
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                cls = ctx.enclosing(node, ast.ClassDef)
+                if cls is not None and \
+                        (ctx.norm_path, cls.name, node.attr) \
+                        not in guarded.class_fields:
+                    own = own_fields_memo.get(id(cls))
+                    if own is None:
+                        own = own_fields_memo[id(cls)] = \
+                            _own_fields(ctx, cls)
+                    if node.attr in own:
+                        continue
+            # iteration discipline: find the "view expression" — the
+            # field itself or field.items()/.values()/.keys()
+            view = node
+            parent = ctx.parents.get(view)
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _VIEW_METHODS:
+                call = ctx.parents.get(parent)
+                if isinstance(call, ast.Call) and call.func is parent:
+                    view = call
+            vparent = ctx.parents.get(view)
+            bad_iter = False
+            if isinstance(vparent, ast.Call) and view in vparent.args:
+                fname = vparent.func.id \
+                    if isinstance(vparent.func, ast.Name) else ""
+                if fname in _ITERATORS:
+                    bad_iter = True
+                # _MATERIALIZERS and everything else: fine
+            elif isinstance(vparent, ast.For) and vparent.iter is view:
+                bad_iter = True
+            elif isinstance(vparent, ast.comprehension) and \
+                    vparent.iter is view:
+                bad_iter = True
+            if bad_iter:
+                out.append(ctx.finding(
+                    node, "lock-snapshot",
+                    f"Python-level iteration over live "
+                    f"{_dotted(node)} (guarded-by=snapshot) — "
+                    f"materialize with list()/dict() first"))
+            # double-read bookkeeping (per statement, per root.field)
+            stmt = _nearest_stmt(ctx, node)
+            if stmt is not None:
+                key = (id(stmt), f"{_dotted(node.value)}.{node.attr}")
+                per_stmt.setdefault(key, []).append(node)
+        seen_stmt: Set[Tuple[int, str]] = set()
+        for (stmt_id, dotted), nodes in per_stmt.items():
+            if len(nodes) < 2 or (stmt_id, dotted) in seen_stmt:
+                continue
+            seen_stmt.add((stmt_id, dotted))
+            out.append(ctx.finding(
+                nodes[0], "lock-snapshot",
+                f"{dotted} (guarded-by=snapshot) read "
+                f"{len(nodes)} times in one statement — another thread "
+                f"can change it between reads; snapshot to a local "
+                f"first"))
+    return out

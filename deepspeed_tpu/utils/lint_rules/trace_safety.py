@@ -1,0 +1,236 @@
+"""trace-safety rules: what may not happen inside a jitted function body.
+
+Scope detection is structural, not nominal: a function is a *jit scope*
+when the file passes it to ``jax.jit`` (``jax.jit(step, ...)`` /
+``jit(step)`` — the engine's ``return jax.jit(mixed_step, ...)`` builder
+pattern), decorates it with ``@jax.jit`` / ``@partial(jax.jit, ...)``, or
+jits a lambda in place. Everything lexically inside such a function runs
+at TRACE time: its parameters are tracers, so Python control flow on
+them, host casts, and shape-dependent loop bounds either crash the trace
+or silently change the compile fingerprint — exactly what the runtime
+recompile sentinel alarms on, one TPU window too late.
+
+Taint is deliberately simple: the jitted function's parameters are
+tracers; a local assigned from an expression that mentions a tainted
+name is tainted. Closure variables are NOT tainted (the builder pattern
+closes over static config), which is what keeps this rule quiet on
+``if t_tokens is None:``-style static dispatch in the builders.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from .core import FileCtx, Finding
+
+_HOST_CASTS = {"int", "float", "bool"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as an expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.expr]:
+    """The function expression being jitted by this call, if any."""
+    if _is_jax_jit(call.func) and call.args:
+        return call.args[0]
+    # partial(jax.jit, ...) used as a decorator factory
+    if isinstance(call.func, ast.Name) and call.func.id == "partial" \
+            and call.args and _is_jax_jit(call.args[0]):
+        return None  # handled at the decorator site
+    return None
+
+
+def find_jit_scopes(ctx: FileCtx) -> List[ast.AST]:
+    """FunctionDefs / Lambdas whose bodies trace under jax.jit."""
+    defs_by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    scopes: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node: ast.AST) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            scopes.append(node)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    add(node)
+                elif isinstance(dec, ast.Call) and (
+                        _is_jax_jit(dec.func)
+                        or (isinstance(dec.func, ast.Name)
+                            and dec.func.id == "partial" and dec.args
+                            and _is_jax_jit(dec.args[0]))):
+                    add(node)
+        if isinstance(node, ast.Call):
+            target = _jit_target(node)
+            if target is None:
+                continue
+            if isinstance(target, ast.Lambda):
+                add(target)
+            elif isinstance(target, ast.Name):
+                # nearest def with that name ABOVE the call wins (the
+                # builder pattern defines then jits in the same scope)
+                best = None
+                for d in defs_by_name.get(target.id, []):
+                    if d.lineno <= node.lineno and \
+                            (best is None or d.lineno > best.lineno):
+                        best = d
+                if best is not None:
+                    add(best)
+    return scopes
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _params_of(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _bound_names(t: ast.AST) -> Set[str]:
+    """Names a target BINDS: plain names and tuple/list/star elements —
+    NOT the roots of attribute/subscript writes (``self.x[k] = v`` binds
+    nothing; it mutates closed-over state)."""
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in t.elts:
+            out |= _bound_names(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _bound_names(t.value)
+    return set()
+
+
+def _locals_of(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out |= _bound_names(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            out |= _bound_names(node.target)
+    return out
+
+
+def _taint(fn: ast.AST) -> Set[str]:
+    """Parameters + locals assigned from tainted expressions (one forward
+    pass; good enough for straight-line jitted bodies)."""
+    tainted = _params_of(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign) and \
+                    _names_in(node.value) & tainted:
+                for t in node.targets:
+                    tainted |= _bound_names(t)
+    return tainted
+
+
+def _is_none_check(test: ast.expr) -> bool:
+    """`x is None` / `x is not None` — the static-optional-arg pattern."""
+    return isinstance(test, ast.Compare) and \
+        all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops) and \
+        all(isinstance(c, ast.Constant) and c.value is None
+            for c in test.comparators)
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in find_jit_scopes(ctx):
+        params = _params_of(fn)
+        tainted = _taint(fn)
+        local = _locals_of(fn) | params
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # nested defs trace too (helpers defined inside the
+                # jitted body), so do not skip them
+                if isinstance(node, (ast.If, ast.While)):
+                    hits = _names_in(node.test) & tainted
+                    if hits and not _is_none_check(node.test):
+                        out.append(ctx.finding(
+                            node, "trace-branch",
+                            f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                            f"on traced value(s) {', '.join(sorted(hits))} "
+                            f"inside a jitted function"))
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in _HOST_CASTS:
+                        hits = set()
+                        for arg in node.args:
+                            hits |= _names_in(arg) & tainted
+                        if hits:
+                            out.append(ctx.finding(
+                                node, "trace-host-cast",
+                                f"{f.id}() on traced value(s) "
+                                f"{', '.join(sorted(hits))} inside a "
+                                f"jitted function"))
+                    elif isinstance(f, ast.Attribute) and \
+                            f.attr == "item" and not node.args:
+                        out.append(ctx.finding(
+                            node, "trace-host-cast",
+                            ".item() inside a jitted function (host "
+                            "sync / trace failure)"))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                            continue
+                        root = t
+                        while isinstance(root, (ast.Attribute,
+                                                ast.Subscript)):
+                            root = root.value
+                        if isinstance(root, ast.Name) and \
+                                root.id not in local:
+                            out.append(ctx.finding(
+                                node, "trace-closure-state",
+                                f"write to closed-over state "
+                                f"{root.id!r} inside a jitted function "
+                                f"(runs once per XLA compile, not per "
+                                f"call)"))
+                elif isinstance(node, ast.For):
+                    it = node.iter
+                    if isinstance(it, ast.Call) and \
+                            isinstance(it.func, ast.Name) and \
+                            it.func.id == "range":
+                        bound_names = set()
+                        shaped = False
+                        for arg in it.args:
+                            bound_names |= _names_in(arg) & tainted
+                            for sub in ast.walk(arg):
+                                if isinstance(sub, ast.Attribute) and \
+                                        sub.attr in ("shape", "size",
+                                                     "ndim") and \
+                                        _names_in(sub) & tainted:
+                                    shaped = True
+                                if isinstance(sub, ast.Call) and \
+                                        isinstance(sub.func, ast.Name) and \
+                                        sub.func.id == "len" and sub.args \
+                                        and _names_in(sub.args[0]) & tainted:
+                                    shaped = True
+                        if shaped or bound_names:
+                            out.append(ctx.finding(
+                                node, "trace-shape-arith",
+                                "Python loop bounded by a traced "
+                                "argument's shape — unrolls per shape, "
+                                "every new shape is a new executable"))
+    return out

@@ -259,3 +259,109 @@ def test_trace_view_summary_aggregates_slo(srv, tmp_path):
     assert s["slo_verdicts"].get("good", 0) >= 1
     # mixed-step engine spans aggregate as before
     assert "mixed_step" in s["engine_spans"]
+
+
+# ---------------------------------------------------------------------------
+# probe-thread snapshot discipline (dslint lock-discipline counterparts)
+# ---------------------------------------------------------------------------
+
+def test_healthz_survives_wedge_clearing_mid_probe():
+    """health() runs on the admin probe thread while the ENGINE thread
+    may clear ``_wedged`` between the probe's None check and its
+    ``is_alive()`` call. The probe must read the field ONCE (the
+    ``guarded-by=snapshot`` law dslint enforces): the double-read
+    version raised AttributeError — a 500 from the very endpoint whose
+    contract is 200-or-503."""
+
+    class _Thread:
+        def is_alive(self):
+            return True
+
+    class _Metrics:
+        steps = 3
+        watchdog_trips = 1
+        logit_quarantines = 0
+
+    class _WedgeClearsMidProbe:
+        # _wedged reads are served by this property: the first read (the
+        # None check) sees a live-looking thread, every later read sees
+        # None — the exact interleave of the engine clearing the wedge
+        # between the probe's two reads
+        def __init__(self):
+            self._reads = 0
+            self.metrics = _Metrics()
+            self._last_trip_time = None
+            self._last_quarantine_time = None
+
+        @property
+        def _wedged(self):
+            self._reads += 1
+            return _Thread() if self._reads == 1 else None
+
+    fake = _WedgeClearsMidProbe()
+    ok, detail = ServingEngine.health(fake)
+    assert ok is False
+    assert detail["wedged"] is True
+    assert fake._reads == 1  # exactly one snapshot read
+
+
+def test_live_engines_listing_locked_against_construction():
+    """``live_serving_engines()`` must snapshot under the module lock:
+    WeakSet iteration runs Python-level bytecode, so an unlocked
+    ``list(_LIVE_ENGINES)`` racing an engine construction on another
+    thread raised ``RuntimeError: Set changed size during iteration``
+    (ds_report's speculation section scraping while a replica builds)."""
+    import sys
+    import threading
+
+    from deepspeed_tpu.inference.serving import engine as engine_mod
+
+    class Dummy:  # weakref-able stand-in for an engine under construction
+        pass
+
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    stop = threading.Event()
+
+    def churn():
+        keep = []
+        while not stop.is_set():
+            d = Dummy()
+            keep.append(d)
+            with engine_mod._live_engines_lock:
+                engine_mod._LIVE_ENGINES.add(d)
+            if len(keep) > 32:
+                del keep[:16]  # dropped refs churn removals too
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        for _ in range(20000):
+            engine_mod.live_serving_engines()  # raised pre-fix
+    finally:
+        stop.set()
+        t.join()
+        sys.setswitchinterval(old)
+
+
+def test_slo_burn_rate_is_one_consistent_snapshot():
+    """The burn rate divides a sum by a length; both must come from ONE
+    point-in-time copy of the window. Summing the live deque and then
+    len()-ing it again (the pre-fix shape) divides a numerator by a
+    denominator from a DIFFERENT window when the engine appends a
+    verdict between the two reads mid-scrape."""
+    from deepspeed_tpu.inference.serving.metrics import ServingMetrics
+
+    class _GrowsBetweenReads:
+        # iteration sees the window as it was (3 misses); a separate
+        # len() read sees the post-append window (6 slots) — exactly the
+        # torn read the single-snapshot discipline forbids
+        def __iter__(self):
+            return iter([1, 1, 1])
+
+        def __len__(self):
+            return 6
+
+    m = ServingMetrics()
+    m.slo_window = _GrowsBetweenReads()
+    assert m.slo_burn_rate == 1.0

@@ -268,3 +268,44 @@ def test_dense_generate_registers_per_bucket_programs():
     assert small["recompiles"] == 0
     assert small["flops"] and small["flops"] > 0  # captured on call two
     assert "inference/generate[b1,t32,n4]" in table  # bucket churn visible
+
+
+def test_program_table_is_point_in_time_under_registration():
+    """``table()`` feeds /statusz from the admin thread while the engine
+    registers per-bucket programs; it must materialize a snapshot
+    (``list()`` first — the same law ``recompile_total`` already
+    follows) instead of sorting a live dict view. The hammer pins the
+    no-exception contract and that every returned row is whole."""
+    import sys
+    import threading
+
+    reg = perf.ProgramRegistry()
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    stop = threading.Event()
+
+    def register():
+        i = 0
+        while not stop.is_set():
+            reg.program(f"prog{i}")
+            i += 1
+            if i % 128 == 0:
+                # bound the table size while keeping key churn hot;
+                # writers follow the same lock discipline readers rely
+                # on (the pre-lock sorted-live-view version of table()
+                # raised RuntimeError under exactly this churn)
+                with reg._lock:
+                    for j in range(i - 128, i):
+                        reg.programs.pop(f"prog{j}", None)
+
+    t = threading.Thread(target=register, daemon=True)
+    t.start()
+    try:
+        for _ in range(400):
+            rows = reg.table()
+            assert all(isinstance(r, dict) and "name" in r for r in rows)
+            reg.recompile_total
+    finally:
+        stop.set()
+        t.join()
+        sys.setswitchinterval(old)

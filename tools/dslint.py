@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""dslint — repo-specific static analysis gate (``tools/dslint.py``).
+
+Runs the AST rule families of ``deepspeed_tpu/utils/lint_rules/`` over a
+source tree and exits non-zero on any NEW finding (not baselined, not
+pragma-exempted). Pure AST + tokenize: no jax import, no accelerator,
+sub-second over the whole package — cheap enough that tier-1 runs it as
+an ordinary test and every PR pays it.
+
+Usage:
+  python tools/dslint.py --check deepspeed_tpu/          # the CI gate
+  python tools/dslint.py --check path/to/file.py         # one file
+  python tools/dslint.py --check deepspeed_tpu/ --json   # machine output
+  python tools/dslint.py --list-rules                    # the catalog
+  python tools/dslint.py --check deepspeed_tpu/ --write-baseline
+      # grandfather every current finding (shrink-only file from then on)
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+
+Exemption workflow (docs/static-analysis.md): fix it; or annotate the
+line ``# dslint: ignore[rule-id] <reason>`` with a real reason; or — for
+pre-existing debt only — let ``--write-baseline`` record it in
+``tools/dslint_baseline.json``. The baseline is matched by (path, rule,
+snippet), so line drift never resurrects a grandfathered finding, and
+the shipped baseline holds ZERO entries for ``inference/serving/`` and
+``monitor/`` — those packages are clean by construction.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deepspeed_tpu.utils.lint_rules import (  # noqa: E402
+    RULES, load_baseline, run_lint, write_baseline)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "dslint_baseline.json")
+
+
+def list_rules() -> None:
+    fam = None
+    for rid in sorted(RULES, key=lambda r: (RULES[r]["family"], r)):
+        meta = RULES[rid]
+        if meta["family"] != fam:
+            fam = meta["family"]
+            print(f"\n[{fam}]")
+        print(f"  {rid:<22}{meta['what']}")
+        print(f"  {'':<22}front-runs: {meta['counterpart']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-specific static analysis (see "
+                    "docs/static-analysis.md)")
+    ap.add_argument("--check", metavar="PATH", nargs="+", default=None,
+                    help="files/dirs to lint (the CI gate runs "
+                         "deepspeed_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default tools/dslint_baseline"
+                         ".json; 'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record every current NEW finding into the "
+                         "baseline and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        list_rules()
+        return 0
+    if not args.check:
+        ap.print_usage()
+        print("dslint: --check PATH required (or --list-rules)",
+              file=sys.stderr)
+        return 2
+    for p in args.check:
+        if not os.path.exists(p):
+            print(f"dslint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = None if args.baseline == "none" else args.baseline
+    baseline = load_baseline(baseline_path)
+    t0 = time.perf_counter()
+    report = run_lint(args.check, baseline=baseline)
+    dt = time.perf_counter() - t0
+
+    if args.write_baseline:
+        merged = list(report.findings)
+        write_baseline(baseline_path or DEFAULT_BASELINE,
+                       merged + [f for f in report.baselined])
+        print(f"dslint: baseline written with "
+              f"{len(merged) + len(report.baselined)} entr(ies) -> "
+              f"{baseline_path or DEFAULT_BASELINE}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in report.findings],
+            "baselined": len(report.baselined),
+            "suppressed": len(report.suppressed),
+            "files": report.files,
+            "ignore_pragmas": report.pragma_count,
+            "wall_s": round(dt, 3),
+        }, indent=1))
+    else:
+        for f in report.findings:
+            print(f.render())
+        print(f"dslint: {len(report.findings)} finding(s) in "
+              f"{report.files} file(s) ({len(report.baselined)} "
+              f"baselined, {len(report.suppressed)} pragma-exempted, "
+              f"{report.pragma_count} ignore pragma(s) in tree) "
+              f"[{dt:.2f}s]")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
