@@ -263,6 +263,23 @@ def speculation_report() -> None:
               f"{st['pages_dropped']} pages rolled back)")
 
 
+def fleet_report() -> None:
+    """Fleet status of every live ServingRouter in this process: the
+    per-replica health/goodput table plus routed/requeued/incident
+    counters (``monitor/export.py:fleet_statusz`` — the same text the
+    fleet /statusz endpoint serves). Per-process like the engine and
+    admin-server registries: a fresh ``ds_report`` CLI run has no
+    routers; call from inside a serving process (or a test)."""
+    from deepspeed_tpu.inference.serving import live_serving_routers
+    from deepspeed_tpu.monitor.export import fleet_statusz
+
+    routers = live_serving_routers()
+    if not routers:
+        return  # nothing to report; stay silent like the program table
+    for router in routers:
+        print(fleet_statusz(router), end="")
+
+
 def checkpoint_report(ckpt_dir: str) -> int:
     """Checkpoint fsck (``ds_report --verify-checkpoint DIR``): validate
     every save's manifest in a checkpoint dir, print the last-good tag.
@@ -333,6 +350,7 @@ def main(argv=None):
     dslint_report()
     perf_report()
     speculation_report()
+    fleet_report()
     comm_report()
     op_report()
     return 0

@@ -6,3 +6,8 @@ from .speculative import Drafter, PromptLookupDrafter  # noqa: F401
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      StepWatchdogTimeout, init_serving,
                      live_serving_engines)
+from .replica import Replica  # noqa: F401
+from .router import (FleetMetrics, FleetOutput, FleetRequest,  # noqa: F401
+                     RouterConfig, ServingRouter, init_fleet,
+                     live_serving_routers)
+from .fleet import copy_kv_pages, transfer_prefix_kv  # noqa: F401

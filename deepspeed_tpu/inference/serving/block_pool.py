@@ -137,6 +137,12 @@ class BlockPool:
         """Unreferenced pages kept warm in the prefix cache."""
         return len(self._cached)
 
+    @property
+    def indexed_count(self) -> int:
+        """Live content-indexed pages (referenced + cached) — the size of
+        the prefix index a fleet router's affinity probe searches."""
+        return len(self._block_hash)
+
     def occupancy(self) -> float:
         return self.used_count / self.num_blocks
 
@@ -188,6 +194,18 @@ class BlockPool:
             self.tracer.instant("prefix_evict", cat="pool",
                                 args={"block": bid,
                                       "cached": len(self._cached)})
+
+    def drop_cached(self) -> int:
+        """Evict EVERY refcount-0 cached page (and its index entries) back
+        to the blank list; returns the count. Models the cold restart of
+        a killed fleet replica: a dead process's warm KV does not survive
+        its memory, so the router's kill drill must not leave a prefix
+        index a real restart would never have."""
+        n = 0
+        while self._cached:
+            self._evict_one()
+            n += 1
+        return n
 
     def free(self, block_ids: List[int], owner: str) -> None:
         """Release ``owner``'s references. A page whose last reference

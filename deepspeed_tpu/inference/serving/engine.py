@@ -631,15 +631,25 @@ class ServingEngine:
         self.metrics.requests_cancelled += 1
         return True
 
-    def drain(self, max_steps: Optional[int] = None) -> Dict[str, "RequestOutput"]:
-        """Graceful shutdown: stop admitting (submits now raise
-        ``RejectedError("draining")``), shed everything still queued, and
-        step until every resident finishes. Returns all retained outputs.
-        ``resume_admission()`` reopens the engine."""
+    def begin_drain(self) -> None:
+        """Stop admitting (submits now raise ``RejectedError("draining")``)
+        and shed everything still queued, WITHOUT stepping: the fleet
+        router drains one replica while the rest absorb — residents here
+        keep stepping in the normal drive loop until they run dry, and
+        the shed requests re-enter the router's fleet queue. (The
+        single-engine path is :meth:`drain`, which also steps to
+        completion.) ``resume_admission()`` reopens the engine."""
         self._draining = True
         for req in list(self.sched.queue):
             self.sched.cancel(req, "drained")
             self.metrics.requests_shed += 1
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[str, "RequestOutput"]:
+        """Graceful shutdown: stop admitting, shed everything still
+        queued (:meth:`begin_drain`), and step until every resident
+        finishes. Returns all retained outputs. ``resume_admission()``
+        reopens the engine."""
+        self.begin_drain()
         steps = 0
         # has_work(), not "slots occupied": a resident preempted-and-
         # requeued mid-drain sits in the QUEUE between steps and must still
@@ -666,6 +676,27 @@ class ServingEngine:
             return self._brownout_forced
         thr = self.config.brownout_occupancy
         return thr is not None and self.block_pool.occupancy() >= thr
+
+    def request(self, rid: str) -> Request:
+        """The LIVE request record (read-only by contract). The fleet
+        router's per-step done/state probe — :meth:`poll` copies the
+        prompt and token lists, which is the wrong cost for a scan over
+        every in-flight request every router tick."""
+        return self._requests[rid]
+
+    def live_rids(self, state: Optional[RequestState] = None) -> List[str]:
+        """Rids of retained requests that are NOT yet terminal,
+        optionally narrowed to one live state — the fleet layer's
+        kill/drain enumeration (the public seam; reaching into the
+        retention dict is not part of the contract)."""
+        out: List[str] = []
+        for rid, req in list(self._requests.items()):
+            if state is None:
+                if not req.done:
+                    out.append(rid)
+            elif req.state is state:
+                out.append(rid)
+        return out
 
     def poll(self, rid: str) -> RequestOutput:
         """Non-blocking status + tokens-so-far for a request."""

@@ -1,0 +1,98 @@
+"""Fleet-level KV movement: the prefill -> decode page handoff.
+
+The disaggregated serving mode (``RouterConfig.prefill_replicas``) runs a
+prompt's chunked prefill on a dedicated replica, then hands the committed
+KV pages to the decode replica that will stream the answer. The handoff
+rides the machinery both pools already have:
+
+1. the prompt's full-block :class:`~.block_pool.ChainKey` chain names the
+   pages on BOTH sides (keys compare by value across pools — content
+   addressing is the transfer protocol);
+2. pages the destination already holds are skipped (idempotent handoff —
+   a retried hop after a kill re-sends only what is missing);
+3. transferred pages are committed into the destination's content index
+   and parked on its cached LRU, so the decode replica's ordinary
+   admission path MATCHES them like any other prefix hit and computes
+   only the uncached tail. No engine code changes for disaggregation —
+   the transfer is invisible to the engine by construction.
+
+:func:`copy_kv_pages` is the one device-touching step, a host-side gather
+/ scatter between two pools (fine for the CPU fleets tests and benches
+run). Its signature — (src pool, dst pool, src page ids, dst page ids) —
+is exactly the shape a TPU transfer collective takes (The Big Send-off,
+arxiv 2504.18658: sender gathers pages, receiver scatters them), so the
+fast path replaces this one function, not the router.
+"""
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .engine import ServingEngine
+
+#: reference-set owner id for pages in transit (allocated, written,
+#: content-indexed, then released onto the cached LRU in one handoff)
+TRANSFER_OWNER = "__kv_transfer__"
+
+
+def copy_kv_pages(src_pool, dst_pool, src_ids: Sequence[int],
+                  dst_ids: Sequence[int]):
+    """Copy pages ``src_pool[:, src_ids] -> dst_pool[:, dst_ids]`` across
+    every pool array (K, V, int8 scales). Pool arrays carry the leading
+    layer axis ``[L, N, ...]``; both pools must share the layout (same
+    model family, same block size — the router enforces block size)."""
+    si = jnp.asarray(list(src_ids), jnp.int32)
+    di = jnp.asarray(list(dst_ids), jnp.int32)
+    return jax.tree_util.tree_map(
+        lambda d, s: d.at[:, di].set(s[:, si]), dst_pool, src_pool)
+
+
+def transfer_prefix_kv(src: ServingEngine, dst: ServingEngine,
+                       tokens: Sequence[int]) -> int:
+    """Hand the committed full-block KV prefix of ``tokens`` from ``src``
+    to ``dst``: copy the page contents and content-index them on the
+    destination so its admission matches the prefix. Returns pages
+    transferred (0 when the source has nothing committed, the
+    destination already holds the chain, or the destination pool cannot
+    take the pages right now — the decode replica then simply recomputes,
+    which is the correct degradation)."""
+    if src is dst:
+        return 0
+    src_pool, dst_pool = src.block_pool, dst.block_pool
+    hashes = src_pool.prefix_block_hashes(tokens)
+    # the live committed chain on the source (lookup, not match_prefix:
+    # the transfer wants EVERY committed block, including the last full
+    # one admission's at-least-one-computed-token cap would exclude)
+    src_ids: List[int] = []
+    for h in hashes:
+        bid = src_pool.lookup(h)
+        if bid is None:
+            break
+        src_ids.append(bid)
+    # skip every block the destination already holds LIVE, per block
+    # rather than contiguous-head-only: with a gapped destination chain
+    # (middle block LRU-evicted, later block still live) a head-only
+    # skip would copy pages whose commit first-writer-wins into a no-op
+    # — a wasted device copy counted as transferred. Copying INTO a gap
+    # is still right: the chain heals and everything behind it becomes
+    # matchable again.
+    todo = [(h, sbid) for h, sbid in zip(hashes[:len(src_ids)], src_ids)
+            if dst_pool.lookup(h) is None]
+    n = len(todo)
+    if n == 0 or not dst_pool.can_allocate(n):
+        return 0
+    dst_ids = dst_pool.allocate(n, TRANSFER_OWNER)
+    try:
+        dst.pool = copy_kv_pages(src.pool, dst.pool,
+                                 [sbid for _, sbid in todo], dst_ids)
+        for (h, _), bid in zip(todo, dst_ids):
+            dst_pool.commit_hash(bid, h)
+    except BaseException:
+        dst_pool.free(dst_ids, TRANSFER_OWNER)
+        raise
+    # release the transfer reference: the pages are hashed, so they park
+    # on the cached LRU — exactly where a local prefill would have left
+    # them — and the next admission's match_prefix revives them
+    dst_pool.free(dst_ids, TRANSFER_OWNER)
+    return n

@@ -1,0 +1,227 @@
+"""One fleet replica: a ServingEngine plus the router-facing probe surface.
+
+The router never reaches into an engine's internals to decide anything —
+everything it routes on comes through this wrapper, and every method here
+is the in-process analog of something a cross-process router would scrape
+over HTTP (``monitor/export.py`` serves the same bits):
+
+- :meth:`probe_health`   — ``/healthz``: wedged backend, stale heartbeat;
+- :meth:`ready_reasons`  — ``/readyz``: draining / brownout / cold, plus
+  the replica-level drain the router itself imposed;
+- :meth:`signals`        — the PR 8 load-balancing signals (queue depth,
+  active residents, ``slo_burn_rate``, goodput) scraped from the
+  serving snapshot;
+- :meth:`prefix_match_tokens` — the content-index probe behind
+  prefix-affinity routing (``BlockPool.match_prefix`` on precomputed
+  chain keys; keys compare by VALUE, so one hash pass serves every
+  replica's probe).
+
+Kill/revive model the process dying and a supervisor restarting it, for
+the in-process fleets tests and benches run: a kill cancels every live
+request through the scheduler (the pages return exactly as a dead
+process's memory returns to the host — so ``check_consistent`` stays
+meaningful fleet-wide) and DROPS the prefix cache + content index (a
+restarted process has no warm KV). The XLA compile cache survives only
+because the Python process does; a real restart pays the cold start,
+which is exactly what the ``/readyz`` ``cold`` reason guards.
+"""
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .block_pool import ChainKey
+from .engine import ServingEngine
+from .scheduler import RequestState
+
+
+class Replica:
+    """A router-managed serving replica (engine + membership state)."""
+
+    def __init__(self, idx: int, engine: ServingEngine,
+                 name: Optional[str] = None):
+        self.idx = idx
+        self.name = name or f"r{idx}"
+        self.engine = engine
+        #: False between :meth:`kill` and :meth:`revive` — a dead process:
+        #: never routed to, never stepped
+        self.alive = True
+        #: True while unhealthy (wedge / stale heartbeat): membership kept
+        #: (it may recover) but no NEW traffic is dispatched here
+        self.ejected = False
+        #: True while the router drains this replica (its own engine also
+        #: reports ``draining`` via /readyz once begin_drain ran)
+        self.draining = False
+        #: router step the last kill happened at (drives auto-revive)
+        self.killed_at_step: Optional[int] = None
+        # lifecycle counters (the fleet /statusz + ds_report rows)
+        self.kills = 0
+        self.revives = 0
+        self.ejections = 0
+        self.readmissions = 0
+        #: heartbeat: (engine steps, perf_counter stamp) at the last
+        #: observed progress — a replica that HAS work but whose step
+        #: counter stops advancing is wedged in a way /healthz may not
+        #: see (e.g. an external driver thread died)
+        self._last_progress: Tuple[int, float] = (
+            engine.metrics.steps, time.perf_counter())
+
+    # -- probes (the scrape surface) -----------------------------------
+
+    def note_progress(self) -> None:
+        """Stamp the heartbeat when the engine's step counter advanced
+        (or it has nothing to do — idle is not stale)."""
+        steps = self.engine.metrics.steps
+        if steps != self._last_progress[0] or not self.engine.has_work():
+            self._last_progress = (steps, time.perf_counter())
+
+    def heartbeat_stale(self, timeout_s: float) -> bool:
+        if timeout_s <= 0 or not self.alive:
+            return False
+        if not self.engine.has_work():
+            return False
+        return time.perf_counter() - self._last_progress[1] > timeout_s
+
+    def probe_health(self, heartbeat_stale_s: float = 0.0
+                     ) -> Tuple[bool, List[str]]:
+        """The router's /healthz view: (healthy, reasons). A dead replica
+        is trivially unhealthy; a live one is unhealthy while the engine
+        reports a wedged backend or the heartbeat went stale."""
+        if not self.alive:
+            return False, ["dead"]
+        reasons: List[str] = []
+        ok, _ = self.engine.health()
+        if not ok:
+            reasons.append("wedged")
+        if self.heartbeat_stale(heartbeat_stale_s):
+            reasons.append("heartbeat_stale")
+        return (not reasons), reasons
+
+    def ready_reasons(self) -> List[str]:
+        """The /readyz reasons, plus the router-imposed drain."""
+        if not self.alive:
+            return ["dead"]
+        _, detail = self.engine.readiness()
+        reasons = list(detail.get("reasons", ()))
+        if self.draining and "draining" not in reasons:
+            reasons.append("draining")
+        return reasons
+
+    @property
+    def routable(self) -> bool:
+        """May the router dispatch NEW work here at all? (Brownout and
+        cold merely deprioritize — see the router's candidate ranking.)"""
+        return self.alive and not self.ejected and not self.draining
+
+    def signals(self) -> Dict[str, Any]:
+        """The goodput-weighted routing signals (PR 8's scrape fields):
+        live queue depth + residents, rolling SLO burn rate, goodput."""
+        m = self.engine.metrics
+        return {
+            "queue_depth": self.engine.sched.queue_depth,
+            "active_seqs": len(self.engine.sched.active()),
+            "slo_burn_rate": m.slo_burn_rate,
+            "goodput_tokens_per_sec": m.goodput_tokens_per_sec,
+            "kv_occupancy": self.engine.block_pool.occupancy(),
+        }
+
+    def load_score(self, burn_weight: float = 8.0) -> float:
+        """Scalar routing load: requests in the replica's pipeline plus
+        the burn rate scaled to request units (a replica failing its SLO
+        budget reads as loaded even when its queue happens to be short
+        — the goodput-weighted half of the routing policy)."""
+        s = self.signals()
+        return (s["queue_depth"] + s["active_seqs"]
+                + s["slo_burn_rate"] * burn_weight)
+
+    def prefix_match_tokens(self, tokens: Sequence[int],
+                            hashes: List[ChainKey]) -> int:
+        """Tokens of ``tokens`` this replica's content index can serve
+        from cached KV — exactly what admission would match (the
+        at-least-one-computed-token cap included)."""
+        pool = self.engine.block_pool
+        return len(pool.match_prefix(tokens, hashes)) * pool.block_size
+
+    def prefix_index_blocks(self) -> int:
+        """Size of the content index (live hashed pages) — the fleet
+        status row's 'how warm is this replica' number."""
+        return self.engine.block_pool.indexed_count
+
+    # -- lifecycle (kill / revive / drain) -----------------------------
+
+    def kill(self, step_no: int, reason: str = "replica_kill") -> List[str]:
+        """Abrupt death: every live request is cancelled (pages return to
+        the pool exactly as a dead process's memory returns to the host),
+        the prefix cache + content index are dropped (a restart has no
+        warm KV), and admission closes. Returns the rids of the requests
+        that were in flight here — the router requeues them. Idempotent
+        on an already-dead replica (returns [])."""
+        if not self.alive:
+            return []
+        eng = self.engine
+        stranded = eng.live_rids()
+        for rid in stranded:
+            eng.cancel(rid, reason)
+        eng.block_pool.drop_cached()
+        eng.begin_drain()  # queue is already empty; this closes admission
+        self.alive = False
+        self.ejected = False
+        # the drain intent died with the process: a kill mid-drain that
+        # later auto-revives must come back ROUTABLE, not stuck behind a
+        # router-side flag only undrain_replica would ever clear
+        self.draining = False
+        self.killed_at_step = step_no
+        self.kills += 1
+        return stranded
+
+    def revive(self) -> None:
+        """Supervisor restart: reopen admission. (In-process the compiled
+        programs survive; a real restart is cold and /readyz says so.)"""
+        if self.alive:
+            return
+        self.alive = True
+        self.ejected = False
+        self.killed_at_step = None
+        self.engine.resume_admission()
+        self.revives += 1
+        self.note_progress()
+
+    def begin_drain(self) -> List[str]:
+        """Stop admitting here and shed the replica-local queue; returns
+        the shed rids (the router requeues them onto the rest of the
+        fleet while this replica's residents run dry)."""
+        self.draining = True
+        eng = self.engine
+        queued = eng.live_rids(RequestState.QUEUED)
+        eng.begin_drain()
+        return queued
+
+    def end_drain(self) -> None:
+        self.draining = False
+        if self.alive:
+            self.engine.resume_admission()
+
+    def status_row(self) -> Dict[str, Any]:
+        """One fleet-status table row (/statusz + ds_report)."""
+        healthy, health_reasons = self.probe_health()
+        m = self.engine.metrics
+        return {
+            "replica": self.name,
+            "alive": self.alive,
+            "ejected": self.ejected,
+            "draining": self.draining,
+            "healthy": healthy,
+            "health_reasons": health_reasons,
+            "ready_reasons": self.ready_reasons(),
+            **self.signals(),
+            "prefix_index_blocks": self.prefix_index_blocks(),
+            "goodput_tokens": m.goodput_tokens,
+            "slo_verdicts": {"good": m.slo_good,
+                             "ttft_miss": m.slo_ttft_miss,
+                             "tpot_miss": m.slo_tpot_miss,
+                             "shed": m.slo_shed,
+                             "failed": m.slo_failed},
+            "kills": self.kills,
+            "revives": self.revives,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+        }

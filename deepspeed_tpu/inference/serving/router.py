@@ -1,0 +1,908 @@
+"""Serving fleet router: N ServingEngine replicas behind one front door.
+
+Everything below this layer is ONE engine on one mesh; this is the
+scale-out story (DeepSpeed-MII's elastic multi-worker serving, reframed
+for the paged jax engine): the router owns a FLEET-level admission queue
+and dispatches each request onto one of N replicas — in-process replicas
+for tests and benches, each with its own BlockPool, scheduler and admin
+surface; the probe interface (``replica.Replica``) is exactly the bits
+``monitor/export.py`` already serves over HTTP, so a cross-process fleet
+scrapes instead of calling.
+
+Routing is TWO-signal, never plain round-robin:
+
+1. **prefix-cache affinity** — the router probes every candidate
+   replica's content index for the longest :class:`~.block_pool.ChainKey`
+   chain match on the incoming prompt (one hash pass serves every probe:
+   chain keys compare by value across pools) and prefers the replica
+   holding the most cached prefix — the request's prefill is mostly free
+   there, and the fleet's aggregate hit rate compounds because each
+   tenant's traffic keeps landing on the replica that already knows it;
+2. **goodput weighting** — ties break (and affinity is CAPPED) by a load
+   score built from the PR 8 control-plane signals: live queue depth +
+   residents plus the rolling ``slo_burn_rate`` scaled into request
+   units. A replica more than ``load_spill`` requests past the
+   least-loaded one loses its affinity claim — a hot cache must not
+   become a hot spot — and ``/readyz`` reasons (``draining`` /
+   ``brownout`` / ``cold``) exclude or deprioritize candidates before
+   any scoring happens.
+
+Resilience (the fleet half of the overload/chaos ladder):
+
+- a request REJECTED by every replica's admission control stays at the
+  head of the router queue (fleet-level backpressure, FIFO preserved);
+- a request stranded on a dying replica — watchdog-failed, shed by a
+  replica-local drain, displaced, killed — re-enters the router queue
+  and is re-dispatched carrying ``prompt + delivered tokens`` (the
+  recompute-preemption resume semantics, one level up), bounded by
+  ``max_redispatches``;
+- replicas that go unhealthy (``/healthz`` wedge, stale heartbeat) are
+  EJECTED from routing and re-admitted when the probe recovers; their
+  replica-queued requests are cancelled back into the fleet queue while
+  running residents are left to finish or fail on their own;
+- ``kill_replica`` / ``revive_replica`` model process death + supervisor
+  restart (the ``DS_FAULT=replica_kill`` chaos point drives them
+  mid-traffic); a kill returns every page through the scheduler and
+  drops the replica's prefix index, so ``check_consistent`` holds
+  fleet-wide after any storm;
+- ``drain_replica`` generalizes drain to fleet level: one replica stops
+  admitting and runs dry while the rest absorb its shed queue.
+
+Disaggregated prefill (``RouterConfig.prefill_replicas``, off by
+default): dedicated prefill replicas run each prompt's chunked prefill
+(+ first token), then the committed KV pages are handed to a decode
+replica through the content index (``fleet.transfer_prefix_kv`` —
+host-side page copy on CPU; the interface names (src pages, dst pages),
+so a TPU transfer collective in the Big Send-off shape slots in without
+touching the router). The decode replica's admission then MATCHES the
+transferred prefix and computes only the tail.
+"""
+
+import dataclasses
+import itertools
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...monitor.registry import snapshot_items
+from ...utils import fault_injection
+from ...utils.logging import log_dist
+from .block_pool import ChainKey
+from .engine import ServingEngine
+from .replica import Replica
+from .scheduler import RejectedError, RequestState, TERMINAL_STATES
+
+#: live routers in this process (weak — a dropped router vanishes);
+#: ``ds_report``'s fleet section reads from here, like the engine and
+#: admin-server registries. Same lock law: WeakSet iteration is
+#: Python-level bytecode, so an unlocked list() races construction.
+_live_routers_lock = threading.Lock()
+_LIVE_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()  # dslint: guarded-by=_live_routers_lock
+
+
+def live_serving_routers() -> List["ServingRouter"]:
+    """Strong refs to every live ServingRouter in this process."""
+    with _live_routers_lock:
+        return list(_LIVE_ROUTERS)
+
+
+#: replica-terminal reasons the router treats as ITS OWN doing (the fleet
+#: request continues elsewhere, subject to the redispatch budget) rather
+#: than as the request's outcome
+_REQUEUE_CANCEL_REASONS = ("replica_kill", "drained", "router_eject",
+                           "shed_overload")
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Knobs of the fleet router (each replica keeps its own
+    :class:`~.engine.ServingConfig`)."""
+
+    #: "affinity" = prefix-cache-aware + goodput-weighted (the default);
+    #: "load" = goodput/load only (no content-index probe);
+    #: "round_robin" exists ONLY as the A/B control for benches — it is
+    #: deliberately the policy this router was built to beat
+    routing: str = "affinity"
+    #: fleet-level admission bound: queued fleet requests beyond this are
+    #: rejected at the router door (0 = unbounded)
+    max_queue_depth: int = 0
+    #: deadline applied to submits that do not pass their own (seconds)
+    default_deadline_s: Optional[float] = None
+    #: times a request may re-enter the fleet queue after being stranded
+    #: (kill / watchdog / shed) before the router gives up on it
+    max_redispatches: int = 3
+    #: affinity cap: a replica more than this many requests (queue +
+    #: residents + burn-scaled) past the least-loaded candidate loses its
+    #: prefix-affinity claim — the goodput signal overrides the cache one
+    load_spill: float = 4.0
+    #: request-units one unit of ``slo_burn_rate`` adds to the load score
+    #: (a replica burning its SLO budget reads as loaded even when its
+    #: queue happens to be short)
+    burn_weight: float = 8.0
+    #: eject a replica whose engine HAS work but whose step counter has
+    #: not advanced for this long (0 = heartbeat staleness off; the
+    #: wedged-backend /healthz probe is always on)
+    heartbeat_stale_s: float = 0.0
+    #: replica indices dedicated to PREFILL (non-empty = disaggregated
+    #: mode): new requests prefill there (+ first token), then their
+    #: committed KV pages transfer to a decode replica (everyone else)
+    prefill_replicas: Tuple[int, ...] = ()
+    #: auto-revive a killed replica after this many router steps (models
+    #: the supervisor restart a chaos storm relies on; None = manual
+    #: ``revive_replica`` only)
+    revive_after_steps: Optional[int] = None
+    #: TOTAL-outage bound: after this many consecutive ticks with work
+    #: queued, nothing in flight, and ZERO live replicas (and no
+    #: auto-revive configured), queued requests fail terminal
+    #: ``no_replicas`` — without it ``run()``/``drain()`` would spin
+    #: forever when a storm kills the whole fleet. A step-driven server
+    #: whose operator revives inside the bound is unaffected. None
+    #: disables the bound (requests wait indefinitely).
+    outage_fail_steps: Optional[int] = 50
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One request's fleet-level record: the router's durable state, from
+    which any replica serve can be (re)constructed — ``prompt + tokens``
+    is the resume stream, exactly like scheduler preemption."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    priority: int = 0
+    #: absolute ``time.perf_counter()`` stamp; None = no deadline
+    deadline: Optional[float] = None
+    fid: str = dataclasses.field(
+        default_factory=lambda: f"fleet-{next(_fid_counter)}")
+    state: RequestState = RequestState.QUEUED
+    #: tokens DELIVERED to the router so far (a killed replica's
+    #: undelivered tokens die with it and are re-generated; a
+    #: watchdog-failed request's already-delivered tokens survive)
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    #: current placement (None while in the fleet queue)
+    replica: Optional[int] = None
+    rid: Optional[str] = None
+    #: every replica index this request was served on, in order
+    served_on: List[int] = dataclasses.field(default_factory=list)
+    redispatches: int = 0
+    #: disaggregation phase: None (normal) | "prefill" | "decode"
+    phase: Optional[str] = None
+    #: replica whose pool holds this request's committed prefill KV (the
+    #: transfer source for the decode-phase dispatch)
+    kv_source: Optional[int] = None
+    submit_time: float = dataclasses.field(
+        default_factory=time.perf_counter)
+    dispatch_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    #: memoized ChainKey chain of ``resume_tokens`` for the affinity
+    #: probe (content-derived, so valid until the resume stream GROWS —
+    #: a blocked fleet-queue head must not re-hash its prompt every
+    #: router tick; the engines still intern their own keys at submit)
+    route_hashes: List[ChainKey] = dataclasses.field(
+        default_factory=list, repr=False)
+    route_hash_len: int = -1
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    @property
+    def resume_tokens(self) -> List[int]:
+        return self.prompt + self.tokens
+
+    @property
+    def remaining_new(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+_fid_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class FleetOutput:
+    fid: str
+    state: str
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: Optional[str]
+    ttft_s: Optional[float]
+    redispatches: int
+    served_on: List[int]
+
+
+@dataclasses.dataclass
+class FleetMetrics:
+    """Fleet-level counters (per-replica serving metrics stay on each
+    engine; the Prometheus export labels those with ``replica=``)."""
+
+    requests_submitted: int = 0
+    requests_finished: int = 0
+    requests_failed: int = 0
+    requests_timeout: int = 0
+    requests_cancelled: int = 0
+    requests_rejected: int = 0
+    #: stranded requests that re-entered the fleet queue (kill / watchdog
+    #: / replica drain / displacement) — each is one survived incident
+    requests_requeued: int = 0
+    #: dispatches routed because of a prefix-affinity match vs. pure
+    #: load order (the policy's own effectiveness counters)
+    routed_affinity: int = 0
+    routed_load: int = 0
+    replica_kills: int = 0
+    replica_revives: int = 0
+    ejections: int = 0
+    readmissions: int = 0
+    #: disaggregated mode: prefill->decode hops and KV pages handed over
+    disagg_hops: int = 0
+    kv_pages_transferred: int = 0
+    steps: int = 0
+    # gauges
+    queue_depth: int = 0
+    in_flight: int = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {f.name: float(getattr(self, f.name))
+                for f in dataclasses.fields(self)}
+
+
+class ServingRouter:
+    """Fleet front door over N in-process :class:`ServingEngine` replicas.
+
+    Drive with :meth:`submit` / :meth:`step` / :meth:`run` / :meth:`poll`
+    — the same surface as one engine, one level up. Replicas may share
+    one underlying :class:`InferenceEngine` (same params, per-replica
+    KV pools) or bring their own.
+    """
+
+    def __init__(self, engines: List[ServingEngine],
+                 config: Optional[RouterConfig] = None):
+        if not engines:
+            raise ValueError("ServingRouter needs at least one replica")
+        self.cfg = config or RouterConfig()
+        if self.cfg.routing not in ("affinity", "load", "round_robin"):
+            raise ValueError(f"unknown routing policy {self.cfg.routing!r} "
+                             f"(want affinity | load | round_robin)")
+        block_sizes = {e.config.block_size for e in engines}
+        if len(block_sizes) > 1:
+            # one hash pass serves every replica's affinity probe (and
+            # the disaggregated KV handoff) only when pages line up
+            raise ValueError(f"replicas must share block_size for "
+                             f"prefix-affinity routing (got {block_sizes})")
+        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        for i in self.cfg.prefill_replicas:
+            if not 0 <= i < len(self.replicas):
+                raise ValueError(f"prefill_replicas names replica {i}; "
+                                 f"fleet has {len(self.replicas)}")
+        if self.cfg.prefill_replicas and \
+                len(set(self.cfg.prefill_replicas)) >= len(self.replicas):
+            raise ValueError("disaggregation needs at least one replica "
+                             "left for decode")
+        self.metrics = FleetMetrics()
+        #: dispatches per replica index — the routing table's history and
+        #: the balanced-placement routing tiebreak. The admin scrape
+        #: thread renders it, so readers off the router thread take a
+        #: point-in-time copy (new keys appear as replicas first serve)
+        self.routed_by_replica: Dict[int, int] = {}  # dslint: guarded-by=snapshot
+        self.queue: "list[FleetRequest]" = []
+        self._requests: Dict[str, FleetRequest] = {}
+        #: fid -> (replica idx, replica rid) for every dispatched request.
+        #: The admin scrape thread reads it for gauges, so readers outside
+        #: the router thread must materialize a point-in-time copy
+        self._placements: Dict[str, Tuple[int, str]] = {}  # dslint: guarded-by=snapshot
+        self._step_no = 0
+        self._draining = False
+        self._rr = 0
+        #: consecutive ticks of total outage (queue blocked, no live
+        #: replica) — drives the outage_fail_steps terminal bound
+        self._outage_steps = 0
+        with _live_routers_lock:
+            _LIVE_ROUTERS.add(self)
+        log_dist(f"ServingRouter: {len(self.replicas)} replicas, "
+                 f"routing={self.cfg.routing}"
+                 + (f", prefill_replicas={list(self.cfg.prefill_replicas)}"
+                    if self.cfg.prefill_replicas else ""), ranks=[0])
+
+    # ------------------------------------------------------------------
+    # public API (one engine's surface, one level up)
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> str:
+        """Enqueue on the FLEET queue; returns the fleet request id.
+        Raises :class:`RejectedError` when the router door refuses
+        (fleet queue full / fleet draining)."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # fleet-door capacity validation (mirrors ServingEngine.submit):
+        # a request NO replica could ever hold must raise HERE, at the
+        # caller — reaching dispatch it would raise out of step() and
+        # strand everything else in flight. A request only SOME replicas
+        # can hold is admitted; dispatch skips the too-small ones.
+        total = len(prompt) + max_new_tokens
+        if total > max(r.engine.config.max_model_len
+                       for r in self.replicas):
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds every replica's "
+                f"max_model_len (largest: "
+                f"{max(r.engine.config.max_model_len for r in self.replicas)})")
+        if not any(r.engine.block_pool.blocks_for_tokens(total)
+                   <= min(r.engine.nb_max, r.engine.block_pool.num_blocks)
+                   for r in self.replicas):
+            raise ValueError(
+                f"request needs "
+                f"{self.replicas[0].engine.block_pool.blocks_for_tokens(total)} "
+                f"KV blocks at its length cap; no replica's pool serves "
+                f"that many per sequence (raise num_blocks/max_model_len)")
+        if self._draining:
+            self.metrics.requests_rejected += 1
+            raise RejectedError("draining", "fleet is draining; "
+                                "no new admissions")
+        if self.cfg.max_queue_depth and \
+                len(self.queue) >= self.cfg.max_queue_depth:
+            self.metrics.requests_rejected += 1
+            raise RejectedError(
+                "queue_full", f"fleet queue depth {len(self.queue)} at "
+                f"cap {self.cfg.max_queue_depth}")
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        deadline = None if deadline_s is None \
+            else time.perf_counter() + float(deadline_s)
+        freq = FleetRequest(prompt=prompt, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id, priority=int(priority),
+                            deadline=deadline,
+                            phase="prefill" if self.cfg.prefill_replicas
+                            else None)
+        self.queue.append(freq)
+        self._requests[freq.fid] = freq
+        self.metrics.requests_submitted += 1
+        return freq.fid
+
+    def try_submit(self, prompt_ids, max_new_tokens: int = 16,
+                   eos_token_id: Optional[int] = None,
+                   deadline_s: Optional[float] = None,
+                   priority: int = 0) -> Optional[str]:
+        """None instead of RejectedError when the router door sheds."""
+        try:
+            return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                               eos_token_id=eos_token_id,
+                               deadline_s=deadline_s, priority=priority)
+        except RejectedError:
+            return None
+
+    def poll(self, fid: str) -> FleetOutput:
+        freq = self._requests[fid]
+        return FleetOutput(fid=freq.fid, state=freq.state.value,
+                           prompt=list(freq.prompt),
+                           tokens=list(freq.tokens),
+                           finish_reason=freq.finish_reason,
+                           ttft_s=freq.ttft,
+                           redispatches=freq.redispatches,
+                           served_on=list(freq.served_on))
+
+    def cancel(self, fid: str, reason: str = "cancelled") -> bool:
+        """Cancel from any live state (False once terminal). A dispatched
+        request is cancelled on its replica the same call."""
+        # fold any already-terminal replica outcome in first: a request
+        # that finished last step but was not yet collected must report
+        # FINISHED, not be clobbered to CANCELLED
+        self._collect()
+        freq = self._requests[fid]
+        if freq.done:
+            return False
+        if freq.fid in self._placements:
+            idx, rid = self._placements.pop(freq.fid)
+            rep = self.replicas[idx]
+            rep.engine.cancel(rid, "fleet_cancel")
+            # the cancelled segment's partial tokens were already
+            # delivered to the caller's stream: keep them on the record
+            self._deliver(freq, rep.engine.forget(rid))
+        elif freq in self.queue:
+            self.queue.remove(freq)
+        self._fleet_release(freq, RequestState.CANCELLED, reason)
+        return True
+
+    def forget(self, fid: str) -> FleetOutput:
+        """Release the router's retained state for a request (cancelling
+        it first when still live); returns the final output."""
+        freq = self._requests[fid]
+        if not freq.done:
+            self.cancel(fid, "forgotten")
+        out = self.poll(fid)
+        del self._requests[fid]
+        return out
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self._placements)
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> Dict[str, FleetOutput]:
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {fid: self.poll(fid) for fid in self._requests}
+
+    def drain(self, max_steps: Optional[int] = None
+              ) -> Dict[str, FleetOutput]:
+        """Fleet-level drain: stop fleet admission and run everything in
+        flight (and queued) to a terminal state. ``resume_admission()``
+        reopens the door."""
+        self._draining = True
+        return self.run(max_steps=max_steps)
+
+    def resume_admission(self) -> None:
+        self._draining = False
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def kill_replica(self, idx: int, reason: str = "replica_kill") -> int:
+        """Abrupt replica death (chaos drill / operator action): every
+        in-flight request there re-enters the fleet queue (undelivered
+        tokens die with the process and are re-generated elsewhere), its
+        pages return, its prefix index drops. Returns the number of
+        stranded requests requeued."""
+        rep = self.replicas[idx]
+        was_alive = rep.alive
+        stranded = rep.kill(self._step_no, reason)
+        if was_alive:
+            self.metrics.replica_kills += 1
+        log_dist(f"fleet: replica {rep.name} killed "
+                 f"({len(stranded)} in-flight requeued)", ranks=[0])
+        # the cancelled requests are collected (and requeued) on the spot
+        # so a same-step revive cannot race their re-dispatch
+        self._collect()
+        return len(stranded)
+
+    def revive_replica(self, idx: int) -> None:
+        rep = self.replicas[idx]
+        if rep.alive:
+            return
+        rep.revive()
+        self.metrics.replica_revives += 1
+        log_dist(f"fleet: replica {rep.name} revived", ranks=[0])
+
+    def drain_replica(self, idx: int) -> int:
+        """Drain ONE replica while the rest absorb: it stops admitting,
+        its replica-queued requests re-enter the fleet queue, and its
+        residents run dry in the normal step loop. Returns the number of
+        requests shed back to the fleet."""
+        rep = self.replicas[idx]
+        shed = rep.begin_drain()
+        self._collect()
+        return len(shed)
+
+    def undrain_replica(self, idx: int) -> None:
+        self.replicas[idx].end_drain()
+
+    # ------------------------------------------------------------------
+    # one router tick
+    # ------------------------------------------------------------------
+
+    def step(self) -> None:
+        """One fleet tick: chaos probes -> health sweep -> deadline sweep
+        -> dispatch from the fleet queue -> step every live replica ->
+        collect terminals (requeueing the stranded)."""
+        self._chaos_probe()
+        self._health_sweep()
+        self._expire_queued()
+        self._dispatch()
+        for rep in self.replicas:
+            if rep.alive and rep.engine.has_work():
+                rep.engine.step()
+            rep.note_progress()
+        self._collect()
+        self._check_total_outage()
+        self._step_no += 1
+        m = self.metrics
+        m.steps += 1
+        m.queue_depth = len(self.queue)
+        m.in_flight = len(self._placements)
+
+    def _check_total_outage(self) -> None:
+        """Bound the whole-fleet-dead livelock: with work queued, nothing
+        in flight, zero live replicas and no supervisor auto-revive,
+        nothing can ever progress — past ``outage_fail_steps`` ticks the
+        queued requests fail terminal ``no_replicas`` so drive loops
+        terminate instead of spinning."""
+        total_outage = bool(self.queue) and not self._placements and \
+            not any(r.alive for r in self.replicas) and \
+            self.cfg.revive_after_steps is None
+        if not total_outage:
+            self._outage_steps = 0
+            return
+        self._outage_steps += 1
+        if self.cfg.outage_fail_steps is None or \
+                self._outage_steps <= self.cfg.outage_fail_steps:
+            return
+        log_dist(f"fleet: total outage for {self._outage_steps} ticks "
+                 f"with no auto-revive; failing {len(self.queue)} queued "
+                 f"request(s)", ranks=[0])
+        for freq in list(self.queue):
+            self.queue.remove(freq)
+            self._fleet_release(freq, RequestState.FAILED, "no_replicas")
+        self._outage_steps = 0
+
+    def _chaos_probe(self) -> None:
+        """``DS_FAULT=replica_kill[:replica=N][:step=K]`` kills one
+        replica mid-traffic (the storm drill). A malformed or dead pin
+        falls back to the first live replica — an injection point must
+        never crash the loop it is drilling."""
+        spec = fault_injection.maybe_flag("replica_kill",
+                                          tag="serving_fleet",
+                                          step=self._step_no)
+        if spec is None:
+            return
+        alive = [r.idx for r in self.replicas if r.alive]
+        if not alive:
+            return
+        try:
+            pin = int(spec.params["replica"])
+        except (KeyError, ValueError):
+            pin = alive[0]
+        if pin not in alive:
+            pin = alive[0]
+        self.kill_replica(pin)
+
+    def _health_sweep(self) -> None:
+        """Eject unhealthy replicas (no NEW dispatches; their queued work
+        returns to the fleet), re-admit recovered ones, auto-revive
+        killed ones past the supervisor delay."""
+        for rep in self.replicas:
+            if not rep.alive:
+                if self.cfg.revive_after_steps is not None and \
+                        rep.killed_at_step is not None and \
+                        self._step_no - rep.killed_at_step >= \
+                        self.cfg.revive_after_steps:
+                    self.revive_replica(rep.idx)
+                continue
+            healthy, reasons = rep.probe_health(self.cfg.heartbeat_stale_s)
+            if not healthy and not rep.ejected:
+                rep.ejected = True
+                rep.ejections += 1
+                self.metrics.ejections += 1
+                log_dist(f"fleet: replica {rep.name} ejected "
+                         f"({','.join(reasons)})", ranks=[0])
+                # replica-queued work must not wait out the incident:
+                # cancel it back into the fleet queue (running residents
+                # are left to finish or fail on their own — the replica's
+                # watchdog owns them)
+                for fid, (idx, rid) in list(self._placements.items()):
+                    if idx != rep.idx:
+                        continue
+                    if rep.engine.request(rid).state is RequestState.QUEUED:
+                        rep.engine.cancel(rid, "router_eject")
+            elif healthy and rep.ejected:
+                rep.ejected = False
+                rep.readmissions += 1
+                self.metrics.readmissions += 1
+                log_dist(f"fleet: replica {rep.name} re-admitted", ranks=[0])
+
+    def _expire_queued(self) -> None:
+        now = time.perf_counter()
+        for freq in [f for f in self.queue
+                     if f.deadline is not None and now > f.deadline]:
+            self.queue.remove(freq)
+            self._fleet_release(freq, RequestState.TIMEOUT, "deadline")
+
+    # -- routing -------------------------------------------------------
+
+    def _candidates(self, phase: Optional[str]) -> List[Replica]:
+        """Dispatchable replicas for this phase. ``/readyz`` semantics at
+        fleet level: ``draining`` excludes, ``brownout`` deprioritizes
+        (used only when nothing else can take the request), and ``cold``
+        deliberately does NOT — the balanced-placement tiebreak in
+        :meth:`_route` warms spare replicas on idle ties, because a fleet
+        whose spares never warm cannot absorb a kill storm (an EXTERNAL
+        LB fronting latency-critical traffic is what the cold bit is
+        for)."""
+        reps = self.replicas
+        if self.cfg.prefill_replicas:
+            pset = set(self.cfg.prefill_replicas)
+            want_prefill = phase == "prefill"
+            reps = [r for r in reps if (r.idx in pset) == want_prefill]
+        pairs = []
+        for r in reps:
+            if not r.routable:
+                continue
+            reasons = r.ready_reasons()
+            if "draining" in reasons:
+                continue
+            pairs.append((r, "brownout" in reasons))
+        full = [r for r, browned in pairs if not browned]
+        return full or [r for r, _ in pairs]
+
+    def _route(self, tokens: List[int], phase: Optional[str],
+               hashes: Optional[List[ChainKey]] = None
+               ) -> List[Tuple[int, Replica]]:
+        """Ranked ``(prefix_match_tokens, replica)`` candidates, best
+        first; dispatch walks the ranking until one replica's admission
+        accepts. Ranking key: longest capped prefix match, then load
+        score, then fewest-ever-routed (balanced placement — spreads
+        idle ties and slow-starts cold replicas), then index. Pass the
+        request's memoized ``hashes`` (``_prompt_hashes``) — dispatch
+        retries the blocked head every tick and must not re-hash it."""
+        pool = self._candidates(phase)
+        if not pool:
+            return []
+        if self.cfg.routing == "round_robin":
+            k = self._rr
+            self._rr += 1
+            return [(0, pool[(k + i) % len(pool)])
+                    for i in range(len(pool))]
+        loads = {r.idx: r.load_score(self.cfg.burn_weight) for r in pool}
+        min_load = min(loads.values())
+        if hashes is None and self.cfg.routing == "affinity":
+            hashes = pool[0].engine.block_pool.prefix_block_hashes(tokens)
+        hashes = hashes or []
+        ranked = []
+        for r in pool:
+            pfx = r.prefix_match_tokens(tokens, hashes) if hashes else 0
+            if loads[r.idx] > min_load + self.cfg.load_spill:
+                # the affinity cap: past the spill threshold the cached
+                # replica loses its claim and sorts purely by load —
+                # a hot cache must not become a hot spot
+                pfx = 0
+            ranked.append((-pfx, loads[r.idx],
+                           self.routed_by_replica.get(r.idx, 0),
+                           r.idx, r))
+        ranked.sort(key=lambda t: t[:4])
+        return [(-t[0], t[4]) for t in ranked]
+
+    def _dispatch(self) -> None:
+        """Move fleet-queue heads onto replicas, FIFO: the head that no
+        replica accepts stays put and blocks the queue (fleet-level
+        backpressure — the same head-of-line law as engine admission)."""
+        while self.queue:
+            if not self._dispatch_one(self.queue[0]):
+                return
+            self.queue.pop(0)
+
+    def _dispatch_one(self, freq: FleetRequest) -> bool:
+        """Place one fleet request; True = the head was CONSUMED (placed,
+        or released terminal) and may be popped, False = blocked (no
+        replica accepts right now). Never touches the queue itself."""
+        now = time.perf_counter()
+        deadline_s = None
+        if freq.deadline is not None:
+            deadline_s = freq.deadline - now
+            if deadline_s <= 0:
+                self._fleet_release(freq, RequestState.TIMEOUT, "deadline")
+                return True
+        resume = freq.resume_tokens
+        budget = 1 if freq.phase == "prefill" else freq.remaining_new
+        for pfx, rep in self._route(resume, freq.phase,
+                                    self._prompt_hashes(freq, resume)):
+            try:
+                rid = rep.engine.try_submit(resume, max_new_tokens=budget,
+                                            eos_token_id=freq.eos_token_id,
+                                            deadline_s=deadline_s,
+                                            priority=freq.priority)
+            except ValueError:
+                # the fleet door validated that SOME replica can hold
+                # this request; on a heterogeneous fleet this one is too
+                # small for it — a capability mismatch, not a caller bug
+                continue
+            if rid is None:
+                continue
+            if freq.phase == "decode" and freq.kv_source is not None:
+                # the handoff lands BETWEEN submit and the replica's next
+                # step — admission matches the transferred prefix there
+                self._handoff_kv(freq, rep)
+            freq.replica, freq.rid = rep.idx, rid
+            freq.served_on.append(rep.idx)
+            freq.state = RequestState.RUNNING
+            freq.dispatch_time = now
+            self._placements[freq.fid] = (rep.idx, rid)
+            routed = self.routed_by_replica  # one field read (RMW below)
+            routed[rep.idx] = routed.get(rep.idx, 0) + 1
+            if pfx > 0:
+                self.metrics.routed_affinity += 1
+            else:
+                self.metrics.routed_load += 1
+            return True
+        return False
+
+    def _prompt_hashes(self, freq: FleetRequest,
+                       resume: List[int]) -> Optional[List[ChainKey]]:
+        """The request's memoized affinity-probe chain, rebuilt only when
+        the resume stream grew (requeue delivered tokens). None when the
+        policy never probes the content index."""
+        if self.cfg.routing != "affinity":
+            return None
+        if freq.route_hash_len != len(resume):
+            freq.route_hashes = self.replicas[0].engine.block_pool \
+                .prefix_block_hashes(resume)
+            freq.route_hash_len = len(resume)
+        return freq.route_hashes
+
+    def _handoff_kv(self, freq: FleetRequest, rep: Replica) -> None:
+        """Disaggregated prefill -> decode handoff: copy the committed
+        prefix KV pages from the prefill replica's pool into the decode
+        replica's, content-indexed so its admission matches them. A dead
+        or missing source simply skips the transfer — the decode replica
+        recomputes (correct, just slower), which is exactly the
+        resilience story a storm needs."""
+        from .fleet import transfer_prefix_kv
+
+        src = self.replicas[freq.kv_source]
+        freq.kv_source = None  # one handoff per hop, even on failure
+        if not src.alive:
+            return
+        moved = transfer_prefix_kv(src.engine, rep.engine,
+                                   freq.resume_tokens)
+        self.metrics.kv_pages_transferred += moved
+
+    # -- collection / requeue ------------------------------------------
+
+    def _collect(self) -> None:
+        """Fold replica-terminal requests back into fleet state: finishes
+        deliver tokens (or hop prefill->decode), strandings requeue,
+        deadline expiries time out."""
+        for fid, (idx, rid) in list(self._placements.items()):
+            rep = self.replicas[idx]
+            req = rep.engine.request(rid)
+            if not req.done:
+                continue
+            del self._placements[fid]
+            freq = self._requests[fid]
+            out = rep.engine.forget(rid)
+            freq.replica, freq.rid = None, None
+            if req.state is RequestState.FINISHED:
+                self._on_finished(freq, out, rep)
+            elif req.state is RequestState.TIMEOUT:
+                # partial tokens were delivered before the deadline hit:
+                # the fleet surface reports them like a bare engine does
+                self._deliver(freq, out)
+                self._fleet_release(freq, RequestState.TIMEOUT,
+                                    out.finish_reason or "deadline")
+            elif req.state is RequestState.CANCELLED and \
+                    out.finish_reason not in _REQUEUE_CANCEL_REASONS:
+                # caller-side cancel realized at the replica
+                self._deliver(freq, out)
+                self._fleet_release(freq, RequestState.CANCELLED,
+                                    out.finish_reason or "cancelled")
+            else:
+                # stranded: killed / drained / ejected / displaced /
+                # engine-side failure — the fleet serves it elsewhere.
+                # A kill's undelivered tokens died with the process; any
+                # other stranding happened in a live process whose tokens
+                # were already delivered, so they carry over (resume)
+                if out.finish_reason != "replica_kill":
+                    self._deliver(freq, out)
+                self._requeue(freq, out.finish_reason or req.state.value)
+
+    def _deliver(self, freq: FleetRequest, out) -> None:
+        """Fold one replica segment's output into the fleet record. The
+        fleet TTFT anchors on the REPLICA's measured first-token time
+        (dispatch + its ttft), not on collection time — collection
+        happens at segment end, which would inflate TTFT to total
+        generation latency."""
+        if out.tokens and freq.first_token_time is None:
+            if out.ttft_s is not None and freq.dispatch_time is not None:
+                freq.first_token_time = freq.dispatch_time + out.ttft_s
+            else:
+                freq.first_token_time = time.perf_counter()
+        freq.tokens.extend(out.tokens)
+
+    def _on_finished(self, freq: FleetRequest, out, rep: Replica) -> None:
+        self._deliver(freq, out)
+        hit_eos = freq.eos_token_id is not None and \
+            bool(freq.tokens) and freq.tokens[-1] == freq.eos_token_id
+        if freq.phase == "prefill" and not hit_eos \
+                and freq.remaining_new > 0:
+            # disaggregation hop: prefill (+ first token) done here; the
+            # committed KV hands off to a decode replica at dispatch
+            freq.phase = "decode"
+            freq.kv_source = rep.idx
+            freq.state = RequestState.QUEUED
+            self.metrics.disagg_hops += 1
+            self.queue.insert(0, freq)
+            return
+        reason = out.finish_reason or "length"
+        if freq.remaining_new <= 0 and not hit_eos:
+            reason = "length"
+        self._fleet_release(freq, RequestState.FINISHED, reason)
+
+    def _requeue(self, freq: FleetRequest, reason: str) -> None:
+        if freq.remaining_new <= 0:
+            self._fleet_release(freq, RequestState.FINISHED, "length")
+            return
+        if freq.deadline is not None and \
+                time.perf_counter() > freq.deadline:
+            self._fleet_release(freq, RequestState.TIMEOUT, "deadline")
+            return
+        freq.redispatches += 1
+        if freq.redispatches > self.cfg.max_redispatches:
+            self._fleet_release(freq, RequestState.FAILED,
+                                f"redispatch_budget:{reason}")
+            return
+        freq.state = RequestState.QUEUED
+        self.queue.insert(0, freq)  # stranded work resumes first (the
+        # fleet analog of preemption's requeue-at-front)
+        self.metrics.requests_requeued += 1
+
+    def _fleet_release(self, freq: FleetRequest, state: RequestState,
+                       reason: str) -> None:
+        """THE one place a fleet request's terminal bookkeeping (state /
+        reason / finish time / terminal counters) is written — the
+        router-level mirror of ``Scheduler._release``; the dslint
+        terminal-path rule enforces both."""
+        freq.state = state
+        freq.finish_reason = reason
+        freq.finish_time = time.perf_counter()
+        field = {RequestState.FINISHED: "requests_finished",
+                 RequestState.FAILED: "requests_failed",
+                 RequestState.TIMEOUT: "requests_timeout",
+                 RequestState.CANCELLED: "requests_cancelled"}[state]
+        setattr(self.metrics, field, getattr(self.metrics, field) + 1)
+
+    # -- status (the /statusz fleet section + ds_report) ----------------
+
+    def status(self) -> Dict[str, Any]:
+        """Point-in-time fleet status: per-replica health/goodput rows
+        plus the router's own counters. Safe to call from a scrape
+        thread (reads snapshot copies, never iterates live state)."""
+        goodput = sum(r.engine.metrics.goodput_tokens_per_sec
+                      for r in self.replicas if r.alive)
+        return {
+            "replicas": [r.status_row() for r in self.replicas],
+            "routing": self.cfg.routing,
+            "disaggregated": bool(self.cfg.prefill_replicas),
+            "prefill_replicas": list(self.cfg.prefill_replicas),
+            "queue_depth": len(self.queue),
+            "in_flight": len(self._placements),
+            "draining": self._draining,
+            "fleet_goodput_tokens_per_sec": round(goodput, 2),
+            "routed_by_replica": {self.replicas[i].name: n
+                                  for i, n in
+                                  sorted(snapshot_items(
+                                      self.routed_by_replica))},
+            "counters": self.metrics.snapshot(),
+        }
+
+    def check_consistent(self) -> None:
+        """Fleet-wide pool invariants: every replica's accounting is
+        consistent — after a drain, zero referenced pages anywhere, dead
+        or alive (the chaos-suite bar, fleet edition)."""
+        for rep in self.replicas:
+            rep.engine.block_pool.check_consistent()
+
+
+def init_fleet(engine, n_replicas: int, serving_config=None,
+               router_config: Optional[RouterConfig] = None,
+               serving_configs: Optional[List[Any]] = None
+               ) -> ServingRouter:
+    """Build ``n_replicas`` ServingEngines over ONE shared
+    :class:`InferenceEngine` (same params, per-replica KV pool /
+    scheduler / metrics) and front them with a router — the in-process
+    fleet shape tests and benches drive. ``serving_configs`` overrides
+    the per-replica config list (e.g. smaller pools on prefill
+    replicas)."""
+    if serving_configs is not None and len(serving_configs) != n_replicas:
+        raise ValueError("serving_configs must name every replica")
+    engines = [ServingEngine(engine,
+                             serving_configs[i] if serving_configs
+                             else serving_config)
+               for i in range(n_replicas)]
+    return ServingRouter(engines, config=router_config)

@@ -522,3 +522,125 @@ def serve_admin(srv, port: int, host: str = "127.0.0.1") -> AdminServer:
     admin = AdminServer(port=port, host=host,
                         profile_dir=srv.config.trace_dir)
     return attach_serving_engine(admin, srv)
+
+
+# ---------------------------------------------------------------------------
+# fleet (ServingRouter) attachment
+# ---------------------------------------------------------------------------
+
+def fleet_metrics_text(router) -> str:
+    """The /metrics body for a :class:`ServingRouter`: fleet-level
+    counters under ``ds_fleet_*`` plus EVERY replica's serving snapshot
+    and compile counts as ``replica=``-labeled series — one scrape shows
+    the whole fleet, and a per-replica dashboard is one label filter."""
+    scalars: Dict[str, float] = {
+        f"fleet_{k}": v for k, v in router.metrics.snapshot().items()}
+    for rep in router.replicas:
+        lbl = f"{{replica={rep.name}}}"
+        scalars[f"replica_alive{lbl}"] = float(rep.alive)
+        scalars[f"replica_ejected{lbl}"] = float(rep.ejected)
+        scalars[f"replica_draining{lbl}"] = float(rep.draining)
+        scalars[f"replica_prefix_index_blocks{lbl}"] = float(
+            rep.prefix_index_blocks())
+        for k, v in rep.engine.metrics.snapshot().items():
+            scalars[f"{k}{lbl}"] = v
+        for prog, n in snapshot_items(rep.engine.compile_counts):
+            scalars[f"compile_count{{program={prog},"
+                    f"replica={rep.name}}}"] = float(n)
+    return render_prometheus(scalars=scalars)
+
+
+def fleet_statusz(router) -> str:
+    """The human-readable fleet /statusz section: one row per replica
+    (health, readiness, load, goodput, burn rate, prefix-index size,
+    SLO verdicts) plus the router's routed/requeued/ejected counters."""
+    st = router.status()
+    lines: List[str] = ["== deepspeed_tpu serving fleet ==", ""]
+    lines.append(f"routing: {st['routing']}"
+                 + (f" (disaggregated; prefill replicas "
+                    f"{st['prefill_replicas']})" if st["disaggregated"]
+                    else ""))
+    lines.append(f"fleet queue: {st['queue_depth']} queued, "
+                 f"{st['in_flight']} in flight"
+                 + (" [draining]" if st["draining"] else ""))
+    lines.append(f"fleet goodput: {st['fleet_goodput_tokens_per_sec']:g} "
+                 f"tok/s")
+    lines.append("")
+    lines.append(f"{'replica':<8}{'state':<22}{'queue':>6}{'active':>7}"
+                 f"{'burn':>7}{'goodput':>9}{'pfx_blocks':>11}"
+                 f"{'verdicts (g/tm/pm/s/f)':>24}")
+    for row in st["replicas"]:
+        state = "dead" if not row["alive"] else \
+            ("ejected:" + ",".join(row["health_reasons"])
+             if row["ejected"] else
+             (",".join(row["ready_reasons"]) or "ready"))
+        v = row["slo_verdicts"]
+        verd = (f"{v['good']}/{v['ttft_miss']}/{v['tpot_miss']}"
+                f"/{v['shed']}/{v['failed']}")
+        lines.append(f"{row['replica']:<8}{state:<22}"
+                     f"{row['queue_depth']:>6}{row['active_seqs']:>7}"
+                     f"{row['slo_burn_rate']:>7.2f}"
+                     f"{row['goodput_tokens_per_sec']:>9.1f}"
+                     f"{row['prefix_index_blocks']:>11}{verd:>24}")
+    lines.append("")
+    c = st["counters"]
+    lines.append(f"routed: {int(c['routed_affinity'])} by prefix affinity, "
+                 f"{int(c['routed_load'])} by load; "
+                 f"requeued {int(c['requests_requeued'])}, "
+                 f"rejected {int(c['requests_rejected'])}")
+    lines.append(f"incidents: {int(c['replica_kills'])} kills, "
+                 f"{int(c['replica_revives'])} revives, "
+                 f"{int(c['ejections'])} ejections, "
+                 f"{int(c['readmissions'])} readmissions")
+    if st["disaggregated"]:
+        lines.append(f"disaggregation: {int(c['disagg_hops'])} hops, "
+                     f"{int(c['kv_pages_transferred'])} KV pages "
+                     f"transferred")
+    return "\n".join(lines) + "\n"
+
+
+def attach_fleet(admin: AdminServer, router) -> AdminServer:
+    """Point an :class:`AdminServer` at a live :class:`ServingRouter`:
+    /healthz is fleet liveness (200 while ANY replica can serve),
+    /readyz is fleet readiness (200 while any replica is routable and
+    ready), /metrics carries every replica with ``replica=`` labels.
+    Weak reference, same as the engine attachment."""
+    ref = weakref.ref(router)
+
+    def alive():
+        return ref()
+
+    def metrics_fn() -> str:
+        r = alive()
+        return "" if r is None else fleet_metrics_text(r)
+
+    def health_fn():
+        r = alive()
+        if r is None:
+            return False, {"detail": "router dropped"}
+        healthy = [rep.name for rep in r.replicas
+                   if rep.probe_health(r.cfg.heartbeat_stale_s)[0]]
+        return bool(healthy), {"healthy_replicas": healthy,
+                               "replicas": len(r.replicas)}
+
+    def ready_fn():
+        r = alive()
+        if r is None:
+            return False, {"reasons": ["router dropped"]}
+        routable = [rep.name for rep in r.replicas
+                    if rep.routable and not rep.ready_reasons()]
+        reasons = [] if routable else ["no ready replica"]
+        if r._draining:
+            reasons.append("draining")
+        return (not reasons), {"reasons": reasons,
+                               "ready_replicas": routable}
+
+    def status_fn() -> str:
+        r = alive()
+        return "router dropped\n" if r is None else fleet_statusz(r)
+
+    admin.metrics_fn = metrics_fn
+    admin.health_fn = health_fn
+    admin.ready_fn = ready_fn
+    admin.status_fn = status_fn
+    return admin

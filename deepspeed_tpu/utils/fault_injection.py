@@ -29,6 +29,11 @@ Serving chaos vocabulary (injection points in ``serving/engine.py``)::
                                              # fails, serving continues
     DS_FAULT=slow_step:p=0.2:seconds=0.1     # probabilistic variant: any
                                              # spec may carry p=<prob>
+    DS_FAULT=replica_kill:step=30:replica=1:tag=serving_fleet
+                                             # kill fleet replica 1 at
+                                             # router step 30 (the
+                                             # ServingRouter requeues its
+                                             # in-flight requests)
 
 Recognized match keys: ``step`` / ``rank`` / ``tag`` (spec fires only when
 the injection point reports a matching value), ``fails`` (bounded faults:

@@ -11,8 +11,13 @@ enforce are properties of those subsystems as a whole:
   ``HOST_SYNC_ALLOW`` below (change it deliberately, in review).
 - **terminal-write** — every terminal transition funnels through
   ``Scheduler._release`` (pages back to the pool, SLO hook, terminal
-  span). A bare ``req.state = RequestState.FAILED`` anywhere else leaks
-  pages structurally.
+  span), and every FLEET-level terminal through
+  ``ServingRouter._fleet_release`` (the router-side mirror: terminal
+  counters, finish bookkeeping). A bare ``req.state =
+  RequestState.FAILED`` anywhere else leaks pages structurally — and a
+  fleet requeue path that calls ``_release`` DIRECTLY (instead of the
+  cancel/fail/timeout API) skips the SLO hook and the terminal span, so
+  direct ``_release`` calls outside ``scheduler.py`` are findings too.
 - **acquire-release** — a page acquire inside a ``try`` whose handlers
   swallow without releasing strands pages on the exception edge.
 - **determinism** — ``time.perf_counter`` is the one serving clock
@@ -37,8 +42,9 @@ _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
 
 _TERMINAL_STATES = {"FINISHED", "FAILED", "TIMEOUT", "CANCELLED"}
 _NONTERMINAL_STATES = {"QUEUED", "RUNNING"}
-#: the one place terminal bookkeeping may be written
-_TERMINAL_ALLOW_FUNCS = {"_release"}
+#: the only places terminal bookkeeping may be written: the scheduler's
+#: release (engine level) and the router's mirror (fleet level)
+_TERMINAL_ALLOW_FUNCS = {"_release", "_fleet_release"}
 
 _ACQUIRE_METHODS = {"allocate", "acquire", "cow"}
 
@@ -64,6 +70,7 @@ def check(ctx: FileCtx) -> List[Finding]:
     out.extend(_check_host_sync(ctx))
     if _is_serving(ctx):
         out.extend(_check_terminal(ctx))
+        out.extend(_check_release_calls(ctx))
         out.extend(_check_acquire_release(ctx))
     out.extend(_check_determinism(ctx))
     return out
@@ -164,6 +171,32 @@ def _mentions_request_state(value) -> bool:
         return False
     return any(isinstance(n, ast.Name) and n.id in ("RequestState", "state")
                for n in ast.walk(value))
+
+
+def _check_release_calls(ctx: FileCtx) -> List[Finding]:
+    """Fleet requeue / redispatch paths (the router's cancel, eject and
+    kill handling) must reach terminal state through the scheduler's
+    cancel/fail/timeout API — a direct ``_release`` call from outside
+    ``scheduler.py`` would still return the pages but bypass nothing
+    visibly, which is exactly why it is banned: the API wrappers ARE
+    the one audited seam (and ``_fleet_release`` is the router's own
+    terminal funnel, not a scheduler entry point)."""
+    if ctx.norm_path.endswith("inference/serving/scheduler.py"):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_release"):
+            continue
+        out.append(ctx.finding(
+            node, "terminal-write",
+            f"direct Scheduler._release call in "
+            f"{_enclosing_func_name(ctx, node) or 'module'} — fleet "
+            f"requeue/cancel paths must use the scheduler's "
+            f"cancel/fail/timeout API (or ServingRouter._fleet_release "
+            f"for fleet-level terminals)"))
+    return out
 
 
 def _check_acquire_release(ctx: FileCtx) -> List[Finding]:

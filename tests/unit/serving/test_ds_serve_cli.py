@@ -190,3 +190,28 @@ def test_demo_cannot_mix_with_prompts(tmp_path):
         capture_output=True, text=True, timeout=240, cwd=REPO)
     assert r.returncode == 2
     assert "cannot be combined" in r.stderr
+
+
+def test_replicas_demo_serves_fleet_and_reports(tmp_path):
+    """--replicas N serves through the ServingRouter end to end: every
+    demo request finishes on some replica, the stats line is the fleet
+    one, and the final report carries the fleet status (per-replica
+    rows + router counters) instead of the single-engine blocks."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "6", "--cpu", "--replicas", "2", "--prefix-cache",
+         "--stats-interval-s", "1"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert "fleet steps=" in r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    final = recs[-1]
+    fleet = final["fleet"]
+    assert len(fleet["replicas"]) == 2
+    assert fleet["counters"]["requests_finished"] == 6
+    assert set(final["replica_metrics"]) == {"r0", "r1"}
+    results = [rec for rec in recs[:-1] if "rid" in rec]
+    assert len(results) == 6
+    assert all(rec["state"] == "finished" for rec in results)
+    assert all(rec["served_on"] for rec in results)

@@ -1,0 +1,586 @@
+"""Fleet drills: multi-replica router policy + replica-kill resilience.
+
+The fleet invariant (the chaos-suite bar, one level up):
+
+1. every fleet request reaches a terminal state — a request stranded on
+   a dying replica is re-served elsewhere, not hung;
+2. zero leaked blocks on ANY replica (killed, drained, or surviving);
+3. survivors keep exactly ONE resident compile each — incidents are
+   runtime events, never recompiles;
+4. the fleet accepts and completes fresh traffic afterwards.
+
+Fast tier on CPU (``serving`` + ``chaos`` markers); the heavy kill storm
+runs behind ``slow``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import (RejectedError, RouterConfig,
+                                             ServingConfig, ServingEngine,
+                                             init_fleet)
+from deepspeed_tpu.utils import fault_injection
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+MAX_STEPS = 600
+
+VOCAB = None  # set by the engine fixture
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    global VOCAB
+    cfg = LlamaConfig.tiny(remat=False)
+    VOCAB = cfg.vocab_size
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+def serving_cfg(**kw):
+    base = dict(max_batch_size=2, block_size=8, num_blocks=48,
+                max_model_len=96, prefix_cache=True)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def fleet(engine, n=2, rcfg=None, **scfg_kw):
+    return init_fleet(engine, n, serving_config=serving_cfg(**scfg_kw),
+                      router_config=rcfg)
+
+
+def assert_fleet_invariant(router):
+    for freq in router._requests.values():
+        assert freq.done, (freq.fid, freq.state)
+    router.check_consistent()
+    for rep in router.replicas:
+        assert rep.engine.block_pool.used_count == 0, rep.name
+    # fresh traffic after the incident (resume the door if a drain
+    # closed it)
+    router.resume_admission()
+    fid = router.submit([3, 5, 7], max_new_tokens=2)
+    out = router.run(max_steps=MAX_STEPS)
+    assert out[fid].state == "finished"
+
+
+def _serve_one(router, prompt, new=4):
+    fid = router.submit(prompt, max_new_tokens=new)
+    outs = router.run(max_steps=MAX_STEPS)
+    assert outs[fid].state == "finished", outs[fid]
+    return outs[fid]
+
+
+# ---------------------------------------------------------------------------
+# routing policy
+# ---------------------------------------------------------------------------
+
+def test_prefix_affinity_keeps_tenants_on_their_replica(engine):
+    """Paced shared-prefix traffic sticks to the replica whose content
+    index already holds the prefix; a second tenant lands elsewhere
+    (load order) and sticks there too."""
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(0)
+    pa = rs.randint(1, VOCAB, 24)
+    pb = rs.randint(1, VOCAB, 24)
+
+    def tenant_prompt(prefix):
+        return np.concatenate([prefix, rs.randint(1, VOCAB, 4)])
+
+    first_a = _serve_one(router, tenant_prompt(pa)).served_on[0]
+    first_b = _serve_one(router, tenant_prompt(pb)).served_on[0]
+    assert first_a != first_b  # load order spread the two cold tenants
+    for _ in range(3):
+        assert _serve_one(router, tenant_prompt(pa)).served_on == [first_a]
+        assert _serve_one(router, tenant_prompt(pb)).served_on == [first_b]
+    assert router.metrics.routed_affinity >= 6
+    for rep in router.replicas:
+        assert rep.engine.metrics.prefix_hits >= 3
+    assert_fleet_invariant(router)
+
+
+def test_affinity_capped_by_load_spill(engine):
+    """A replica past the load-spill threshold loses its prefix claim:
+    the goodput/load signal overrides the cache signal."""
+    router = fleet(engine, 2, rcfg=RouterConfig(load_spill=2.0))
+    rs = np.random.RandomState(1)
+    prefix = rs.randint(1, VOCAB, 24)
+    home = _serve_one(
+        router, np.concatenate([prefix, rs.randint(1, VOCAB, 4)])
+    ).served_on[0]
+    # pile load DIRECTLY onto the home replica (queued + running >> spill)
+    rep = router.replicas[home]
+    ballast = [rep.engine.submit(rs.randint(1, VOCAB, 8),
+                                 max_new_tokens=24) for _ in range(6)]
+    fid = router.submit(np.concatenate([prefix, rs.randint(1, VOCAB, 4)]),
+                        max_new_tokens=4)
+    router.step()
+    assert router._requests[fid].served_on == [1 - home]
+    outs = router.run(max_steps=MAX_STEPS)
+    assert outs[fid].state == "finished"
+    # the ballast is engine-local work, not fleet work: drive it out
+    # before the fleet-wide zero-leak check
+    steps = 0
+    while rep.engine.has_work():
+        rep.engine.step()
+        steps += 1
+        assert steps < MAX_STEPS
+    for b in ballast:
+        assert rep.engine.poll(b).state == "finished"
+    assert_fleet_invariant(router)
+
+
+def test_round_robin_control_policy(engine):
+    """The A/B control: round_robin ignores both signals and cycles."""
+    router = fleet(engine, 2, rcfg=RouterConfig(routing="round_robin"))
+    rs = np.random.RandomState(2)
+    prefix = rs.randint(1, VOCAB, 24)
+    placed = [_serve_one(
+        router, np.concatenate([prefix, rs.randint(1, VOCAB, 4)])
+    ).served_on[0] for _ in range(4)]
+    assert placed == [0, 1, 0, 1]
+    assert router.metrics.routed_affinity == 0
+    assert_fleet_invariant(router)
+
+
+# ---------------------------------------------------------------------------
+# replica-kill resilience
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_mid_decode_requests_reserved_elsewhere(engine):
+    """The acceptance drill: kill a replica mid-decode — every stranded
+    request re-enters the fleet queue and finishes elsewhere, zero
+    leaked blocks fleet-wide, survivors keep ONE resident compile, and
+    (greedy) the re-served outputs are token-identical to an
+    undisturbed run."""
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(1, VOCAB, int(rs.randint(6, 14)))
+               for _ in range(8)]
+
+    def drive(kill):
+        router = fleet(engine, 3)
+        fids = [router.submit(p, max_new_tokens=10) for p in prompts]
+        for _ in range(4):
+            router.step()  # mid-decode on every replica
+        if kill:
+            assert router.kill_replica(0) > 0
+        outs = router.run(max_steps=MAX_STEPS)
+        assert all(outs[f].state == "finished" for f in fids), \
+            {f: outs[f].state for f in fids}
+        toks = [outs[f].tokens for f in fids]
+        if kill:
+            assert router.metrics.requests_requeued > 0
+            assert router.metrics.replica_kills == 1
+            dead = router.replicas[0]
+            assert not dead.alive
+            assert dead.engine.block_pool.used_count == 0
+            assert dead.engine.block_pool.cached_count == 0  # cold restart
+            for rep in router.replicas[1:]:
+                assert rep.engine.compile_counts == {"mixed_step": 1}
+            router.revive_replica(0)
+        assert_fleet_invariant(router)
+        return toks
+
+    assert drive(kill=True) == drive(kill=False)
+
+
+def test_killed_replica_auto_revives_and_serves(engine):
+    router = fleet(engine, 2, rcfg=RouterConfig(revive_after_steps=3))
+    rs = np.random.RandomState(4)
+    _serve_one(router, rs.randint(1, VOCAB, 8))
+    router.kill_replica(1)
+    assert not router.replicas[1].alive
+    fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=4)
+            for _ in range(4)]
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids)
+    assert router.replicas[1].alive  # supervisor restart happened
+    assert router.metrics.replica_revives == 1
+    # and it takes traffic again
+    late = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=2)
+            for _ in range(4)]
+    outs = router.run(max_steps=MAX_STEPS)
+    assert any(1 in outs[f].served_on for f in late)
+    assert_fleet_invariant(router)
+
+
+def test_ds_fault_replica_kill_chaos_point(engine, monkeypatch):
+    """``DS_FAULT=replica_kill:step=N[:replica=K]`` drives the kill from
+    the chaos vocabulary — the storm drill's trigger."""
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "replica_kill:step=2:replica=1:tag=serving_fleet")
+    fault_injection.reset()
+    try:
+        router = fleet(engine, 2, rcfg=RouterConfig(revive_after_steps=4))
+        rs = np.random.RandomState(5)
+        fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=8)
+                for _ in range(6)]
+        outs = router.run(max_steps=MAX_STEPS)
+        assert all(outs[f].state == "finished" for f in fids)
+        assert router.metrics.replica_kills == 1
+        assert router.replicas[1].kills == 1
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert_fleet_invariant(router)
+
+
+# ---------------------------------------------------------------------------
+# unhealthy eject / recovery
+# ---------------------------------------------------------------------------
+
+def test_wedged_replica_ejected_then_readmitted(engine, monkeypatch):
+    """A watchdog-wedged replica (healthz 503) is ejected from routing;
+    when the wedge clears it is re-admitted and takes traffic again."""
+    router = fleet(engine, 2, step_watchdog_s=0.25)
+    rs = np.random.RandomState(6)
+    # warm BOTH replicas (the first step carries the compile and is
+    # watchdog-exempt; the drill needs steady-state wedges)
+    warm = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=2)
+            for _ in range(4)]
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[w].state == "finished" for w in warm)
+    assert {i for w in warm for i in outs[w].served_on} == {0, 1}
+
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "slow_step:seconds=0.9:fails=1:tag=serving_step")
+    fault_injection.reset()
+    try:
+        fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=6)
+                for _ in range(4)]
+        t0 = time.perf_counter()
+        outs = router.run(max_steps=MAX_STEPS)
+        # the wedge fired on whichever replica stepped into it; its
+        # packed requests failed there and were re-served on the fleet
+        assert all(outs[f].state == "finished" for f in fids), \
+            {f: outs[f].state for f in fids}
+        assert time.perf_counter() - t0 < 30.0
+        assert router.metrics.ejections >= 1
+        assert router.metrics.requests_requeued >= 1
+        # wait out the abandoned step, then one sweep re-admits
+        deadline = time.perf_counter() + 10.0
+        while not all(rep.probe_health()[0] for rep in router.replicas):
+            assert time.perf_counter() < deadline, "wedge never cleared"
+            time.sleep(0.05)
+        router.step()
+        assert router.metrics.readmissions >= 1
+        assert all(not rep.ejected for rep in router.replicas)
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert_fleet_invariant(router)
+
+
+def test_heartbeat_stale_ejects(engine):
+    """A replica with work whose step counter stops advancing is ejected
+    on the heartbeat signal even while /healthz still answers ok."""
+    router = fleet(engine, 2, rcfg=RouterConfig(heartbeat_stale_s=0.5))
+    rep = router.replicas[0]
+    # strand work on replica 0 outside the router's own stepping, then
+    # backdate its heartbeat: the sweep must eject on staleness alone
+    rep.engine.submit([2, 4, 6], max_new_tokens=2)
+    rep._last_progress = (rep._last_progress[0] - 1,
+                          time.perf_counter() - 10.0)
+    router._health_sweep()
+    assert rep.ejected
+    assert router.metrics.ejections == 1
+    # progress resumes -> healthy -> re-admitted
+    while rep.engine.has_work():
+        rep.engine.step()
+    rep.note_progress()
+    router._health_sweep()
+    assert not rep.ejected
+    assert router.metrics.readmissions == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet drain
+# ---------------------------------------------------------------------------
+
+def test_drain_one_replica_while_fleet_absorbs(engine):
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(7)
+    # small slots: extra submits queue AT the replicas
+    fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=6)
+            for _ in range(8)]
+    router.step()
+    shed = router.drain_replica(0)
+    assert shed > 0  # replica-queued work went back to the fleet
+    assert not router.replicas[0].routable
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids)
+    assert not router.replicas[0].engine.has_work()
+    # everything re-dispatched after the drain ran on replica 1
+    assert router.metrics.requests_requeued >= shed
+    router.undrain_replica(0)
+    assert router.replicas[0].routable
+    assert_fleet_invariant(router)
+
+
+def test_total_outage_bounded_not_livelocked(engine):
+    """Whole fleet dead, no auto-revive: run() must TERMINATE (queued
+    work fails ``no_replicas`` past the outage bound) instead of
+    spinning forever; a revive inside the bound still serves."""
+    router = fleet(engine, 1, rcfg=RouterConfig(outage_fail_steps=5))
+    rs = np.random.RandomState(16)
+    _serve_one(router, rs.randint(1, VOCAB, 8))
+    router.kill_replica(0)
+    fid = router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=4)
+    t0 = time.perf_counter()
+    outs = router.run(max_steps=MAX_STEPS)
+    assert time.perf_counter() - t0 < 10.0
+    assert outs[fid].state == "failed"
+    assert outs[fid].finish_reason == "no_replicas"
+    assert not router.has_work()
+    # a revive inside the bound keeps requests alive instead
+    fid2 = router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=4)
+    for _ in range(3):
+        router.step()
+    assert router.poll(fid2).state == "queued"
+    router.revive_replica(0)
+    outs = router.run(max_steps=MAX_STEPS)
+    assert outs[fid2].state == "finished"
+    assert_fleet_invariant(router)
+
+
+def test_kill_mid_drain_revives_routable(engine):
+    """A replica killed WHILE draining must come back routable on
+    revive: the drain intent died with the process (the stuck-forever
+    alternative would leave the fleet silently degraded post-storm)."""
+    router = fleet(engine, 2, rcfg=RouterConfig(revive_after_steps=2))
+    rs = np.random.RandomState(15)
+    _serve_one(router, rs.randint(1, VOCAB, 8))
+    router.drain_replica(0)
+    assert not router.replicas[0].routable
+    router.kill_replica(0)
+    fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=4)
+            for _ in range(4)]
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids)
+    rep = router.replicas[0]
+    assert rep.alive and not rep.draining and rep.routable
+    assert_fleet_invariant(router)
+
+
+def test_fleet_drain_and_door(engine):
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(8)
+    fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=4)
+            for _ in range(4)]
+    outs = router.drain(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids)
+    with pytest.raises(RejectedError, match="draining"):
+        router.submit([1, 2, 3])
+    router.resume_admission()
+    assert_fleet_invariant(router)
+
+
+def test_oversize_request_rejected_at_fleet_door(engine):
+    """An over-length request must raise at submit (the caller's error),
+    never out of step() where it would strand everything else in
+    flight; partial tokens of a timed-out request stay on the fleet
+    record like they would on a bare engine."""
+    router = fleet(engine, 2, max_model_len=32)
+    with pytest.raises(ValueError, match="max_model_len"):
+        router.submit(list(range(1, 40)), max_new_tokens=8)
+    ok = router.submit([1, 2, 3], max_new_tokens=4)
+    # a very tight deadline lands terminal TIMEOUT mid-decode; whatever
+    # was generated before it must survive on the fleet output
+    slow = router.submit([4, 5, 6], max_new_tokens=24, deadline_s=0.05)
+    outs = router.run(max_steps=MAX_STEPS)
+    assert outs[ok].state == "finished"
+    if outs[slow].state == "timeout" and outs[slow].ttft_s is not None:
+        assert outs[slow].tokens  # partial stream reported, not dropped
+    assert_fleet_invariant(router)
+
+
+def test_fleet_queue_bound_rejects(engine):
+    router = fleet(engine, 1, rcfg=RouterConfig(max_queue_depth=2))
+    assert router.try_submit([1, 2], max_new_tokens=2) is not None
+    assert router.try_submit([1, 2], max_new_tokens=2) is not None
+    assert router.try_submit([1, 2], max_new_tokens=2) is None
+    assert router.metrics.requests_rejected == 1
+    router.run(max_steps=MAX_STEPS)
+    assert_fleet_invariant(router)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill
+# ---------------------------------------------------------------------------
+
+def test_disaggregated_prefill_hands_kv_to_decode_replica(engine):
+    """Dedicated prefill replica computes the prompt; its committed KV
+    pages transfer to the decode replica, whose admission serves them as
+    a prefix hit — token-identical to the plain fleet, zero leaks."""
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(1, VOCAB, 20) for _ in range(4)]
+
+    def drive(disagg):
+        rcfg = RouterConfig(prefill_replicas=(0,)) if disagg else None
+        router = fleet(engine, 2, rcfg=rcfg)
+        fids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        outs = router.run(max_steps=MAX_STEPS)
+        assert all(outs[f].state == "finished" for f in fids)
+        if disagg:
+            m = router.metrics
+            assert m.disagg_hops == len(prompts)
+            assert m.kv_pages_transferred > 0
+            dec = router.replicas[1].engine.metrics
+            assert dec.prefix_hits >= len(prompts)
+            assert dec.cached_prefill_tokens > 0
+            # every request prefilled on 0, decoded on 1
+            for f in fids:
+                assert outs[f].served_on == [0, 1]
+        assert_fleet_invariant(router)
+        return [outs[f].tokens for f in fids]
+
+    assert drive(disagg=True) == drive(disagg=False)
+
+
+def test_disaggregated_survives_prefill_replica_kill(engine):
+    """Kill the prefill replica mid-run: in-flight prompts re-enter the
+    fleet queue; decode-phase hops skip the dead KV source and recompute
+    — correct degradation, no hangs, no leaks."""
+    rs = np.random.RandomState(10)
+    router = fleet(engine, 3,
+                   rcfg=RouterConfig(prefill_replicas=(0, 1),
+                                     revive_after_steps=5))
+    fids = [router.submit(rs.randint(1, VOCAB, 20), max_new_tokens=6)
+            for _ in range(6)]
+    router.step()
+    router.kill_replica(0)
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids), \
+        {f: outs[f].state for f in fids}
+    assert_fleet_invariant(router)
+
+
+# ---------------------------------------------------------------------------
+# kill storm (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_replica_kill_storm(engine, monkeypatch):
+    """The full storm: repeated kills across the fleet mid-traffic (the
+    DS_FAULT step-pinned vocabulary) with supervisor auto-revive; every
+    request terminal, zero leaks anywhere, fresh traffic after."""
+    monkeypatch.setenv(
+        fault_injection.ENV_VAR,
+        "replica_kill:step=6:replica=0:tag=serving_fleet,"
+        "replica_kill:step=14:replica=1:tag=serving_fleet,"
+        "replica_kill:step=22:replica=2:tag=serving_fleet,"
+        "replica_kill:step=30:replica=0:tag=serving_fleet")
+    fault_injection.reset()
+    try:
+        router = fleet(engine, 3,
+                       rcfg=RouterConfig(revive_after_steps=6,
+                                         max_redispatches=8))
+        rs = np.random.RandomState(11)
+        prompts = [rs.randint(1, VOCAB, int(rs.randint(6, 20)))
+                   for _ in range(18)]
+        fids = []
+        i = 0
+        while i < len(prompts) or router.has_work():
+            while i < len(prompts) and len(router.queue) < 3:
+                fids.append(router.submit(prompts[i], max_new_tokens=8))
+                i += 1
+            if router.has_work():
+                router.step()
+        outs = {f: router.poll(f) for f in fids}
+        assert all(outs[f].state == "finished" for f in fids), \
+            {f: outs[f].state for f in fids if outs[f].state != "finished"}
+        assert router.metrics.replica_kills == 4
+        assert router.metrics.replica_revives >= 3
+        assert router.metrics.requests_requeued > 0
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    assert_fleet_invariant(router)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_fleet_metrics_export_and_statusz(engine):
+    from deepspeed_tpu.monitor.export import (fleet_metrics_text,
+                                              fleet_statusz,
+                                              parse_prometheus)
+
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(12)
+    fids = [router.submit(rs.randint(1, VOCAB, 8), max_new_tokens=2)
+            for _ in range(4)]
+    router.run(max_steps=MAX_STEPS)
+    series, types = parse_prometheus(fleet_metrics_text(router))
+    by_replica = {}
+    for (name, labels) in series:
+        lab = dict(labels)
+        if "replica" in lab:
+            by_replica.setdefault(lab["replica"], set()).add(name)
+    assert set(by_replica) == {"r0", "r1"}
+    for names in by_replica.values():
+        assert "ds_tokens_per_sec" in names
+        assert "ds_slo_burn_rate" in names
+        assert "ds_replica_alive" in names
+        assert "ds_compile_count" in names
+    assert series[("ds_fleet_requests_finished", frozenset())] == 4.0
+    page = fleet_statusz(router)
+    assert "r0" in page and "r1" in page and "routed:" in page
+    assert_fleet_invariant(router)
+
+
+def test_fleet_admin_endpoints(engine):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from deepspeed_tpu.monitor.export import AdminServer, attach_fleet
+
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(13)
+    _serve_one(router, rs.randint(1, VOCAB, 8))
+    admin = AdminServer(port=0)
+    attach_fleet(admin, router)
+    try:
+        for ep in ("/healthz", "/readyz", "/metrics", "/statusz"):
+            assert urllib.request.urlopen(admin.url + ep,
+                                          timeout=5).status == 200
+        router.kill_replica(0)
+        router.kill_replica(1)
+        # /metrics must survive the incident it reports
+        assert urllib.request.urlopen(admin.url + "/metrics",
+                                      timeout=5).status == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(admin.url + "/healthz", timeout=5)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["healthy_replicas"] == []
+        router.revive_replica(0)
+        assert urllib.request.urlopen(admin.url + "/healthz",
+                                      timeout=5).status == 200
+    finally:
+        admin.close()
+        router.revive_replica(1)
+    assert_fleet_invariant(router)
+
+
+def test_ds_report_fleet_section(engine, capsys):
+    from deepspeed_tpu import env_report
+
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(14)
+    _serve_one(router, rs.randint(1, VOCAB, 8))
+    env_report.fleet_report()
+    out = capsys.readouterr().out
+    assert "serving fleet" in out
+    assert "r0" in out and "r1" in out
+    assert "routed:" in out

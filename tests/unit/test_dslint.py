@@ -388,6 +388,49 @@ def test_terminal_write_positive_negative(tmp_path):
     assert len(hits) == 2  # _release and the RUNNING write stay quiet
 
 
+def test_release_call_outside_scheduler_flagged(tmp_path):
+    """Fleet requeue paths (router-side cancel/redispatch) must go
+    through the scheduler's cancel/fail/timeout API — a direct
+    ``_release`` call from router code is a finding."""
+    src = """
+    def requeue_stranded(self, req):
+        self.sched._release(req, "cancelled", "replica_kill")
+    """
+    report = lint_src(tmp_path, src, name="router.py",
+                      subdir="inference/serving")
+    hits = rules_at(report, "terminal-write")
+    assert len(hits) == 1
+    assert "cancel/fail/timeout" in hits[0].message
+    assert hits[0].line == line_of(src, "._release(")
+
+
+def test_release_call_allowed_in_scheduler_and_fleet_release(tmp_path):
+    """scheduler.py's own wrappers call ``_release`` freely, and the
+    router's ``_fleet_release`` is the allowed fleet-level terminal
+    funnel (terminal writes there stay quiet)."""
+    sched = """
+    class Scheduler:
+        def cancel(self, req, reason):
+            self._release(req, "cancelled", reason)
+    """
+    lint_src(tmp_path, sched, name="scheduler.py",
+             subdir="inference/serving")
+    router = """
+    class RequestState:
+        FAILED = "failed"
+
+
+    class ServingRouter:
+        def _fleet_release(self, freq, state, reason):
+            freq.state = RequestState.FAILED
+            freq.finish_reason = reason
+            freq.finish_time = 1.0
+    """
+    report = lint_src(tmp_path, router, name="router.py",
+                      subdir="inference/serving")
+    assert not rules_at(report, "terminal-write")
+
+
 def test_terminal_write_scoped_to_serving(tmp_path):
     src = """
     class RequestState:

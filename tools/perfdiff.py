@@ -89,7 +89,21 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         # spec_tokens_per_verify and the speedups), and spec_tokens/widths
         # are configuration, not measurements
         "spec_sweep.spec_tokens", "drafted", "accepted", "pages_dropped",
-        ".widths.")
+        ".widths.",
+        # fleet-sweep bookkeeping (r13): kill/revive/requeue/routed/verdict
+        # counts are the STORM SCHEDULE's volume (the bench asserts the
+        # invariants itself — terminal states, zero leaks, affinity > RR);
+        # the gated fleet signals are the hit rates (higher-is-better by
+        # name), affinity advantage, and the phase walls. The storm
+        # goodput RATE (goodput_tok_s_storm) is deliberately ungated:
+        # tok/s on the 1-core CI box is noise-bound, and the recovery
+        # bar is enforced by the bench's own in-run asserts (every storm
+        # request finishes, the post-storm wave is all-good) — the
+        # deterministic signals, not the rate. kill_steps and replica
+        # counts are configuration.
+        "routed_", "requeued", ".kills", ".revives", "kill_steps",
+        "verdicts.", "kv_pages_transferred", "disagg_hops",
+        "goodput_tokens", "post_storm", "storm.steps", ".replicas")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
