@@ -263,6 +263,40 @@ def speculation_report() -> None:
               f"{st['pages_dropped']} pages rolled back)")
 
 
+def kv_tier_report() -> None:
+    """Tiered-KV status of every live ServingEngine in this process: one
+    row per tier (capacity, occupancy, demote/promote counters) plus the
+    host hit rate and promotion latency percentiles. Per-process like
+    the program table: call from inside a serving process (or a test)."""
+    from deepspeed_tpu.inference.serving import live_serving_engines
+
+    engines = [srv for srv in live_serving_engines()
+               if srv.host_tier is not None]
+    if not engines:
+        return  # nothing to report; stay silent like the program table
+    for srv in engines:
+        st = srv.tier_status()
+        print(f"{'kv tier':<10}{'capacity':>10}{'blocks':>9}{'bytes':>13}"
+              f"{'demoted':>9}{'promoted':>9}{'evicted':>9}")
+        for row in st["tiers"]:
+            cap = row.get("capacity_blocks")
+            print(f"{row['tier']:<10}{str(cap if cap else '-'):>10}"
+                  f"{row['blocks']:>9}"
+                  f"{str(row.get('bytes', '-')):>13}"
+                  f"{row.get('demotions', '-'):>9}"
+                  f"{row.get('promotions', '-'):>9}"
+                  f"{row.get('evictions', '-'):>9}")
+        p50, p95 = st["promote_wait_p50_s"], st["promote_wait_p95_s"]
+        print(f"host tier: hit rate {st['host_hit_rate']:.2f} "
+              f"({st['host_hits']} hits / {st['host_misses']} misses, "
+              f"{st['host_hit_tokens']} tokens), "
+              f"{st['pages_promoted']} promoted "
+              f"({st['promote_cancelled']} cancelled, "
+              f"{st['promote_queue_depth']} in flight), promote wait "
+              f"p50 {'n/a' if p50 is None else f'{p50 * 1e3:.1f}ms'} / "
+              f"p95 {'n/a' if p95 is None else f'{p95 * 1e3:.1f}ms'}")
+
+
 def fleet_report() -> None:
     """Fleet status of every live ServingRouter in this process: the
     per-replica health/goodput table plus routed/requeued/incident
@@ -350,6 +384,7 @@ def main(argv=None):
     dslint_report()
     perf_report()
     speculation_report()
+    kv_tier_report()
     fleet_report()
     comm_report()
     op_report()

@@ -2,6 +2,7 @@ from .block_pool import BlockPool, BlockPoolError  # noqa: F401
 from .scheduler import (RejectedError, Request, RequestState,  # noqa: F401
                         Scheduler, TERMINAL_STATES)
 from .metrics import ServingMetrics  # noqa: F401
+from .kv_tiers import HostTier, KVTier  # noqa: F401
 from .speculative import Drafter, PromptLookupDrafter  # noqa: F401
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      StepWatchdogTimeout, init_serving,

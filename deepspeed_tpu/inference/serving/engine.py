@@ -96,6 +96,36 @@ class StepWatchdogTimeout(RuntimeError):
     """A resident serving step exceeded ``step_watchdog_s`` wall-clock."""
 
 
+@dataclasses.dataclass
+class _Promotion:
+    """One in-flight host->device promotion: a request's WHOLE matched
+    host prefix as one device_put'd payload (one transfer, one fold
+    dispatch — per-page folds would pay one functional pool update
+    each), plus enough identity to validate the fold targets — the
+    request's CURRENT admission segment and the exact page ids it was
+    granted (a preempted/terminal request's pages are back in the pool
+    and may already belong to someone else). ``width`` is the pow2 the
+    payload was padded to (by repeating the last page — duplicate
+    scatter targets with identical updates are deterministic), so the
+    fold program compiles once per width, a set bounded by
+    log2(max pages per sequence)."""
+    req: "Request"
+    block_idxs: List[int]
+    dst_bids: List[int]
+    arr: Any
+    width: int
+    admit_order: int
+    t_sched: float
+
+
+def _tree_ready(tree) -> bool:
+    """Has every leaf of a device_put'd pytree landed on device? Leaves
+    without ``is_ready`` (plain numpy on odd paths) count as landed —
+    the fold would at worst block briefly, never corrupt."""
+    return all(leaf.is_ready() for leaf in jax.tree_util.tree_leaves(tree)
+               if hasattr(leaf, "is_ready"))
+
+
 #: live engines in this process (weak — a dropped engine vanishes);
 #: ``ds_report`` reads speculation status from here, next to the
 #: compiled-program table that is per-process for the same reason.
@@ -189,6 +219,21 @@ class ServingConfig:
     #: can implement the same interface later. The engine never mutates
     #: it, so one instance may serve several engines.
     drafter: Optional[Any] = None
+    # -- tiered KV cache (serving/kv_tiers.py) --------------------------
+    #: host-RAM spill tier capacity in KV pages (0 = no tier). With a
+    #: tier attached, pool evictions DEMOTE (page copied host-side,
+    #: content chain preserved) instead of destroying, admission's
+    #: longest-prefix match extends into the host index, and matched
+    #: host pages stream back up via async promotion overlapping the
+    #: uncached-suffix prefill. Requires ``prefix_cache``.
+    host_cache_blocks: int = 0
+    #: host-tier byte budget (None = unbounded; combines with the block
+    #: cap — whichever is hit first evicts the tier's own LRU)
+    host_cache_bytes: Optional[int] = None
+    #: fold every promotion synchronously at admission instead of
+    #: pumping the queue asynchronously — the A/B control for the
+    #: promotion-overlap benchmark; production keeps this False
+    sync_promote: bool = False
     #: opt-in pow2-bucketed packed widths for the mixed step: instead of
     #: every step paying the full ``[1, max_batch_size - 1 + budget]``
     #: padded token batch (decode-only steps on the XLA reference path
@@ -398,6 +443,40 @@ class ServingEngine:
             lambda a: jax.device_put(a, engine._replicated),
             engine.module.init_paged_cache(cfg.num_blocks, cfg.block_size,
                                            dtype=kv_dtype))
+
+        # -- tiered KV: host-RAM spill tier behind the pool's LRU -------
+        self.host_tier = None
+        if cfg.host_cache_blocks or cfg.host_cache_bytes is not None:
+            if cfg.host_cache_blocks < 0:
+                raise ValueError("host_cache_blocks must be >= 0")
+            if not cfg.prefix_cache:
+                raise ValueError(
+                    "the host KV tier extends the prefix cache "
+                    "(demoted pages are matched by content chain): set "
+                    "prefix_cache=True with host_cache_blocks/bytes")
+            from .kv_tiers import HostTier, fetch_paged_blocks
+
+            self.host_tier = HostTier(max_blocks=cfg.host_cache_blocks,
+                                      max_bytes=cfg.host_cache_bytes,
+                                      tracer=self.tracer)
+            # the reader reads self.pool at CALL time (the engine rebinds
+            # the pool tree every step), so demotion always copies the
+            # current page content; a whole eviction wave is ONE read
+            self.block_pool.attach_host_tier(
+                self.host_tier,
+                lambda bids: fetch_paged_blocks(self.pool, bids))
+        #: in-flight promotions (scheduled host->device transfers not yet
+        #: folded into the pool). Engine-thread owned; the scrape path
+        #: sees only the promote_queue_depth gauge written at step
+        #: bookkeeping, and pump/schedule snapshot-swap before iterating
+        self._promote_q: List[Any] = []  # dslint: guarded-by=snapshot
+        #: fold programs keyed by pow2 page width (bounded by
+        #: log2(pages per sequence) — never observed as a serving
+        #: program: promotion is pool plumbing, not a resident step)
+        self._insert_fns: Dict[int, Any] = {}
+        #: widths whose first fold (carrying the XLA compile) already
+        #: ran — later folds are watchdog-judged (first-beat rule)
+        self._promote_warm: "set[int]" = set()
 
         B = cfg.max_batch_size
         self._tables = np.full((B, self.nb_max), self.block_pool.sentinel,
@@ -929,6 +1008,11 @@ class ServingEngine:
                 return
             self._wedged = None
 
+        # 1c. fold landed host-tier promotions into the pool BEFORE
+        # admission and grant planning: a transfer that arrived since
+        # the last step unblocks its request's grants this very step
+        self._pump_promotions()
+
         # 2. FIFO admission (interleaved with the running batch: admitted
         # requests join this very step's decode, or — chunked — start
         # consuming the step's prefill token budget); brownout caps each
@@ -945,10 +1029,22 @@ class ServingEngine:
                     self.metrics.brownout_admissions += 1
             if req.prefix_len:
                 # prefix-cache hit: these tokens are SERVED without being
-                # recomputed (their pages were acquired, not refilled)
+                # recomputed (their pages were acquired, not refilled —
+                # host-tier hits stream up instead of recomputing)
                 self.metrics.prefix_hits += 1
                 self.metrics.cached_prefill_tokens += req.prefix_len
                 self.metrics.prefill_tokens += req.prefix_len
+            if self.host_tier is not None:
+                if req.host_prefix_len:
+                    self.metrics.kv_host_hits += 1
+                    self.metrics.kv_host_hit_tokens += req.host_prefix_len
+                else:
+                    self.metrics.kv_host_misses += 1
+            if req.host_hits:
+                # host-matched pages: start their async device_put NOW so
+                # the transfers overlap everything the packed step does;
+                # the request's own suffix grants wait only on the fold
+                self._schedule_promotions(req)
             if self._mixed:
                 # unified path: the request's table row is live from
                 # admission (no sentinel rows — its packed segments carry
@@ -965,10 +1061,21 @@ class ServingEngine:
             except Exception as e:
                 self._fail_prefill(req, e)
         self._account_reaped()
+        # second pump: a promotion scheduled by THIS step's admission may
+        # already be ready — folding it here lets the request take its
+        # first suffix grant in the same step. When promotion folds are
+        # the ONLY way anyone can make progress (every resident is
+        # promotion-blocked, nothing else would pack), blocking on the
+        # transfer is free — the packed step had nothing to do — so the
+        # fold waits instead of burning an empty step of TTFT
+        self._pump_promotions(wait=self._promotions_only())
 
         if self._mixed:
             # the whole device half of the step is ONE packed dispatch
             self._step_mixed(t0, brownout)
+            return
+
+        if self._skip_step_if_wedged(t0, brownout):
             return
 
         # 2b. the prefill half of the LEGACY step: at most
@@ -1111,6 +1218,11 @@ class ServingEngine:
         m.prefill_queue_age_s = 0.0 if not prefilling else \
             time.perf_counter() - min(r.submit_time for r in prefilling)
         m.brownout_active = brownout
+        if self.host_tier is not None:
+            m.kv_pages_demoted = self.block_pool.demotions
+            m.kv_host_blocks = len(self.host_tier)
+            m.kv_host_bytes = self.host_tier.bytes
+            m.promote_queue_depth = len(self._promote_q)
         m.recompiles = self.perf.recompile_total
         # HBM watermarks: one capability probe, then free on CPU; on TPU
         # the live/peak bytes ride every snapshot and flight dump
@@ -1313,6 +1425,8 @@ class ServingEngine:
         lengths, block tables — rides as DATA, so any traffic mix reuses
         one compile and one dispatch."""
         cfg = self.config
+        if self._skip_step_if_wedged(t0, brownout):
+            return
 
         # prefill grants: round-robin chunk-sized shares of the step's
         # token budget across mid-prefill residents (admission order);
@@ -1674,6 +1788,11 @@ class ServingEngine:
         number of pages that moved."""
         mapping, src = self.block_pool.defrag_plan()
         moved = sum(1 for old, new in mapping.items() if old != new)
+        # in-flight promotions target pages by id: remap them with the
+        # block tables, or the pump would drop them as stale and strand
+        # their requests promotion-blocked forever
+        for e in list(self._promote_q):
+            e.dst_bids = [mapping[b] for b in e.dst_bids]
         if moved:
             if self._defrag_fn is None:
                 def _gather(pool, src_ids):
@@ -1709,6 +1828,199 @@ class ServingEngine:
         if self.sched.reaped:
             self.metrics.requests_timeout += len(self.sched.reaped)
             self.sched.reaped.clear()
+
+    def _skip_step_if_wedged(self, t0: float, brownout: bool) -> bool:
+        """A watchdog trip EARLIER in this very step (a wedged promotion
+        fold) leaves the backend hung: skip the device half entirely —
+        the step-top gate only covers trips from PREVIOUS steps. Shared
+        by the mixed dispatch and the legacy path; True = caller
+        returns (bookkeeping already finished, latency unrecorded)."""
+        w = self._wedged
+        if w is None or not w.is_alive():
+            return False
+        self.metrics.watchdog_skips += 1
+        self._finish_step_bookkeeping(t0, brownout, record_latency=False)
+        return True
+
+    # -- tiered KV: async host->device promotion ------------------------
+
+    def _promotions_only(self) -> bool:
+        """True when promotion folds are the ONLY path to progress this
+        step: promotions are in flight and every running resident is a
+        promotion-blocked prefiller (no decoder, no grantable chunk).
+        Blocking on the transfer is then free — the packed step would
+        have dispatched nothing — and saves the blocked request a whole
+        step of TTFT. With ANY other runnable work this returns False
+        and the packed step never waits on a transfer."""
+        if not self._promote_q:
+            return False
+        for _, r in self.sched.active():
+            if r.state is not RequestState.RUNNING:
+                continue
+            if not r.prefilling or not r.promote_pending:
+                return False
+        return True
+
+    def _schedule_promotions(self, req: Request) -> None:
+        """Start the async host->device transfer of every host-tier page
+        admission matched for ``req``: ``jax.device_put`` returns
+        immediately (the DMA overlaps whatever the engine does next) and
+        the entry joins the promotion queue; :meth:`_pump_promotions`
+        folds it into the pool once the transfer lands. The host entry
+        itself is consumed only when the page's hash COMMITS into the
+        device index (after the logit guard passed the first suffix
+        chunk), so a corrupted or abandoned promotion never destroys the
+        clean host copy."""
+        hits, req.host_hits = req.host_hits, []
+        if not hits:
+            return
+        # chaos point: DS_FAULT=corrupt_promote:tag=serving_tier poisons
+        # ONE promoted page's payload in transit (float leaves -> NaN).
+        # The existing logit-guard path must quarantine the request on
+        # its first suffix chunk BEFORE the page's hash is re-indexed —
+        # poisoned KV must never enter either tier's content index
+        corrupt = fault_injection.maybe_flag(
+            "corrupt_promote", tag="serving_tier",
+            step=self._step_no) is not None
+        payloads = [p for _, _, p in hits]
+        if corrupt:
+            # payload leaves are host numpy copies by construction
+            # (kv_tiers.fetch_paged_block) — no device sync here
+            payloads[0] = jax.tree_util.tree_map(
+                lambda a: np.full_like(a, np.nan)
+                if np.issubdtype(a.dtype, np.floating) else a, payloads[0])
+        # ONE transfer for the whole matched prefix, padded to a pow2
+        # page width by repeating the last page (duplicate scatter
+        # targets carrying identical content are deterministic), so the
+        # fold program compiles once per width — a bounded set
+        k = len(hits)
+        width = next_pow2(k)
+        payloads += [payloads[-1]] * (width - k)
+        payload = jax.tree_util.tree_map(
+            lambda *ls: np.concatenate(ls, axis=1), *payloads)
+        arr = jax.device_put(payload, self.engine._replicated)
+        idxs = [i for i, _, _ in hits]
+        self._promote_q.append(_Promotion(
+            req=req, block_idxs=idxs,
+            dst_bids=[req.blocks[i] for i in idxs],
+            arr=arr, width=width,
+            admit_order=req.admit_order, t_sched=time.perf_counter()))
+        if self.tracer.enabled:
+            self.tracer.instant("kv_promote_start", cat="pool",
+                                args={"rid": req.rid, "pages": k})
+        if self.config.sync_promote:
+            # the A/B control: block on the transfer and fold at
+            # admission — promotion latency lands squarely in TTFT
+            self._pump_promotions(wait=True)
+
+    def _pump_promotions(self, wait: bool = False) -> None:
+        """Fold every LANDED promotion into the device pool (one
+        fixed-shape scatter per page — compiled once, tier residency
+        rides as data). Entries whose request left its admission segment
+        (preempted / terminal) are dropped — their target pages are back
+        in the pool and may already belong to someone else; the host
+        entries they would have consumed survive for the retry. A
+        not-yet-landed transfer stays queued and blocks only its own
+        request's next grant (the scheduler's ``promote_pending`` gate);
+        the packed step never waits. ``wait=True`` (sync_promote A/B)
+        folds everything immediately. The fold is watchdog-bounded like
+        every other device call (``DS_FAULT=slow_promote`` drills it)."""
+        w = self._wedged
+        if w is not None and w.is_alive():
+            return  # backend wedged: queued transfers wait it out
+        q, self._promote_q = self._promote_q, []
+        if not q:
+            return
+        m = self.metrics
+        tr = self.tracer
+        still: List[Any] = []
+        for i, e in enumerate(q):
+            req = e.req
+            if not (req.state is RequestState.RUNNING
+                    and req.admit_order == e.admit_order
+                    and req.promote_pending > 0
+                    and all(idx < len(req.blocks)
+                            and req.blocks[idx] == bid
+                            for idx, bid in zip(e.block_idxs, e.dst_bids))):
+                m.kv_promote_cancelled += len(e.block_idxs)
+                if tr.enabled:
+                    tr.instant("kv_promote_cancel", cat="pool",
+                               args={"rid": req.rid,
+                                     "pages": len(e.block_idxs)})
+                if req.state is RequestState.RUNNING and \
+                        req.admit_order == e.admit_order:
+                    # the request still EXPECTS this promotion but the
+                    # target pages no longer line up (nothing should
+                    # reach here — defrag remaps the queue — but a
+                    # promotion-blocked request with no promotion coming
+                    # would hold its slot forever): preempt-requeue it,
+                    # so re-admission re-matches both tiers cleanly
+                    self._preempt(req)
+                continue
+            if not wait and not _tree_ready(e.arr):
+                still.append(e)
+                continue
+            pool = self.pool  # snapshot for the guarded thread
+            # dst padded like the payload: the repeated tail pages write
+            # their own content again (idempotent)
+            dst_ids = e.dst_bids + [e.dst_bids[-1]] * (e.width
+                                                       - len(e.dst_bids))
+            dst = jnp.asarray(dst_ids, jnp.int32)
+            step_no = self._step_no
+            fn = self._insert_fns.get(e.width)
+            if fn is None:
+                from .kv_tiers import insert_paged_block
+
+                r = self.engine._replicated
+                fn = self._insert_fns[e.width] = jax.jit(
+                    insert_paged_block,
+                    donate_argnums=self._donate and (0,),
+                    in_shardings=(r, r, r), out_shardings=r)
+
+            def device_fold():
+                # chaos point INSIDE the guarded region: a slow/wedged
+                # promotion is bounded by the step watchdog exactly like
+                # a wedged decode step
+                fault_injection.maybe_stall("slow_promote",
+                                            tag="serving_tier",
+                                            step=step_no)
+                return fn(pool, dst, e.arr)
+
+            try:
+                if e.width in self._promote_warm:
+                    self.pool = self._guarded(device_fold)
+                else:
+                    self.pool = device_fold()
+                    self._promote_warm.add(e.width)
+            except StepWatchdogTimeout as exc:
+                log_dist(f"serving: promotion watchdog tripped for "
+                         f"{req.rid}: {exc}", ranks=[0])
+                m.watchdog_trips += 1
+                self._last_trip_time = time.perf_counter()
+                if tr.enabled:
+                    tr.instant("watchdog_trip", cat="engine",
+                               args={"step": step_no, "rids": [req.rid],
+                                     "where": "kv_promote"})
+                slot = req.slot
+                self.sched.fail(req, "step_watchdog")
+                self._clear_slot_arrays(slot)
+                m.requests_failed += 1
+                self._flight("watchdog_trip", step=step_no,
+                             rids=[req.rid], where="kv_promote",
+                             budget_s=self.config.step_watchdog_s)
+                # backend wedged: nothing else may touch the device —
+                # requeue the rest (the step-top gate takes over)
+                still.extend(q[i + 1:])
+                break
+            req.promote_pending -= len(e.block_idxs)
+            m.kv_pages_promoted += len(e.block_idxs)
+            now = time.perf_counter()
+            m.promote_hist.observe(now - e.t_sched)
+            if tr.enabled:
+                tr.complete("kv_promote", e.t_sched, now, cat="pool",
+                            args={"rid": req.rid,
+                                  "pages": len(e.block_idxs)})
+        self._promote_q.extend(still)
 
     def _guarded(self, fn):
         """Run the device step under the wall-clock watchdog (the
@@ -1839,6 +2151,39 @@ class ServingEngine:
             "pages_dropped": m.spec_pages_dropped,
         }
 
+    def tier_status(self) -> Dict[str, Any]:
+        """Tier-table block for CLI reports (``ds_serve`` final report,
+        ``ds_report``, /statusz): per-tier capacity/occupancy plus the
+        movement counters and promotion latency percentiles. ``enabled``
+        False without a host tier."""
+        if self.host_tier is None:
+            return {"enabled": False}
+        m = self.metrics
+        hist = m.promote_hist
+        return {
+            "enabled": True,
+            "tiers": [
+                {"tier": "device", "capacity_blocks": self.config.num_blocks,
+                 "blocks": self.block_pool.used_count
+                 + self.block_pool.cached_count,
+                 "indexed_blocks": self.block_pool.indexed_count,
+                 "evictions": self.block_pool.evictions,
+                 "demotions": self.block_pool.demotions},
+                self.host_tier.stats(),
+            ],
+            "host_hits": m.kv_host_hits,
+            "host_misses": m.kv_host_misses,
+            "host_hit_tokens": m.kv_host_hit_tokens,
+            "host_hit_rate": round(m.host_hit_rate, 4),
+            "pages_promoted": m.kv_pages_promoted,
+            "promote_cancelled": m.kv_promote_cancelled,
+            "promote_queue_depth": len(self._promote_q),
+            "promote_wait_p50_s": hist.percentile(0.5)
+            if hist.count else None,
+            "promote_wait_p95_s": hist.percentile(0.95)
+            if hist.count else None,
+        }
+
     def _write_table_row(self, req: Request) -> None:
         row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)
         row[:len(req.blocks)] = req.blocks
@@ -1922,8 +2267,11 @@ class ServingEngine:
         — the budget is what bounds prefill's share of the step."""
         budget = self._chunk_budget
         while budget > 0:
+            # promotion-blocked residents are skipped (their next chunk
+            # would attend host pages still in flight) — same rule as
+            # the unified step's grant planner
             pending = sorted((r for _, r in self.sched.active()
-                              if r.prefilling),
+                              if r.prefilling and not r.promote_pending),
                              key=lambda r: r.admit_order)
             if not pending:
                 return

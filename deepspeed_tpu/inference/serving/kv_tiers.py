@@ -1,0 +1,346 @@
+"""Tiered KV cache: the host-RAM spill tier behind the BlockPool.
+
+The device pool's LRU of refcount-0 hashed pages used to evict to
+*nowhere*, capping the prefix index — the product at
+millions-of-users scale (system prompts, multi-turn sessions, RAG
+prefixes) — at HBM size. This module adds the next rung of the ladder:
+eviction becomes **demotion** (the device page is copied host-side and
+its :class:`~.block_pool.ChainKey` chain survives in a host content
+index), and admission's longest-prefix match extends across tiers —
+pages matched on the host schedule an **async promotion**
+(``jax.device_put`` on a promotion queue, pumped by the engine each
+step) that overlaps the uncached-suffix chunked prefill. The design is
+the source paper's own playbook — DeepSpeed ZeRO-Infinity's
+``swap_tensor`` + aio layering (PAPER.md §L6) — applied to serving KV.
+
+Tier discipline (the invariants ``BlockPool.check_consistent`` extends
+across tiers):
+
+- **single residency** — a chain key indexed LIVE on the device never
+  also lives on the host LRU: ``commit_hash`` consumes the host entry
+  the moment the promoted (or recomputed) page enters the device index;
+- **no stranded host pages** — every host entry's chain parent is
+  device-live or host-live (capacity evictions cascade onto children the
+  lost parent orphans), and the tier's byte/LRU accounting is exact;
+- **promotion is re-startable** — a host entry is only consumed on
+  device-index commit, which happens AFTER the engine's logit guard has
+  passed the first suffix chunk. A promotion corrupted in transit
+  (``DS_FAULT=corrupt_promote:tag=serving_tier``) quarantines its
+  request before anything is re-indexed; the clean host copy survives
+  for the retry.
+
+The interface is deliberately tier-generic (:class:`KVTier`): an NVMe
+third tier rides the same ``put/get/contains/evict`` seam later,
+mirroring the reference's aio layer.
+"""
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Set
+
+import jax
+import numpy as np
+
+
+class KVTier:
+    """Protocol of one spill tier keyed by content chain keys. A tier
+    stores page PAYLOADS (a pytree mirroring the device pool's arrays,
+    one page wide) and owns its own capacity policy. ``HostTier`` is the
+    pinned-host-RAM instance; an NVMe tier implements the same four
+    verbs over files + an aio queue without touching the pool or the
+    scheduler."""
+
+    def put(self, key, payload) -> bool:          # pragma: no cover
+        raise NotImplementedError
+
+    def get(self, key):                           # pragma: no cover
+        raise NotImplementedError
+
+    def contains(self, key) -> bool:              # pragma: no cover
+        raise NotImplementedError
+
+    def evict(self, key) -> bool:                 # pragma: no cover
+        raise NotImplementedError
+
+
+def payload_nbytes(payload) -> int:
+    """Total bytes of one page payload (sum over the pool-tree leaves)."""
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree_util.tree_leaves(payload))
+
+
+def fetch_paged_blocks(pool, bids: List[int]):
+    """Read SEVERAL device pages host-side in ONE gather + sync per pool
+    leaf, returning a per-page payload list (each page's pool arrays
+    with a singleton page axis, ``[L, 1, ...]``, ready for the fold
+    scatter). Demotion batches here: an admission that rolls k pages
+    off the device LRU pays one device round-trip, not k — the
+    difference between a host hit costing a step and costing a stall.
+    Each page is COPIED out of the wave's gather buffer: a numpy view
+    would pin the whole k-page buffer for as long as any single entry
+    lives, silently breaking the tier's byte budget."""
+    gathered = jax.tree_util.tree_map(
+        lambda a: np.asarray(a[:, np.asarray(bids, np.int32)]), pool)
+    return [jax.tree_util.tree_map(
+        lambda a: np.ascontiguousarray(a[:, i:i + 1]), gathered)
+        for i in range(len(bids))]
+
+
+def insert_paged_block(pool, dst_ids, payload):
+    """Scatter a promoted payload into the device pool:
+    ``pool[:, dst_ids] = payload`` across every pool array
+    (``dst_ids`` shape [W], payload leaves [L, W, ...]). The engine jits
+    this once per pow2 batch width (payloads pad by repeating the last
+    page — duplicate targets with identical updates are deterministic),
+    so promotion never recompiles a resident program; tier residency
+    rides as data exactly like raggedness does."""
+    return jax.tree_util.tree_map(
+        lambda a, p: a.at[:, dst_ids].set(p), pool, payload)
+
+
+class HostTier(KVTier):
+    """Pinned-host-RAM KV page pool keyed by the same content-addressed
+    :class:`~.block_pool.ChainKey` chains as the device index.
+
+    LRU with a block-count and/or byte budget. Payloads are host numpy
+    copies of whole pages; entries share no storage with the device pool,
+    so a host entry stays valid while a promotion of it is in flight and
+    a replica kill drops the whole tier with the process
+    (:meth:`clear`).
+
+    Chain hygiene: entries are linked parent->children via
+    ``key.prev``. Evicting a key for capacity CASCADES onto host
+    children whose parent is then covered by neither tier — matching
+    stops at the first gap, so an uncovered child could never be served
+    again and keeping it would be exactly the "stranded host page" the
+    consistency check forbids. ``device_live`` (installed by the
+    BlockPool) answers "is this key live in the device index?" for that
+    coverage test. Keys are treated opaquely otherwise (tests may use
+    any hashable stand-in; ``prev`` is read via ``getattr``)."""
+
+    def __init__(self, max_blocks: int = 0,
+                 max_bytes: Optional[int] = None,
+                 device_live: Optional[Callable[[Any], bool]] = None,
+                 tracer=None):
+        if max_blocks < 0:
+            raise ValueError("max_blocks must be >= 0 (0 = unbounded)")
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError("max_bytes must be positive (None = unbounded)")
+        if not max_blocks and max_bytes is None:
+            raise ValueError("HostTier needs a capacity: max_blocks, "
+                             "max_bytes, or both")
+        self.max_blocks = max_blocks
+        self.max_bytes = max_bytes
+        #: "is this key live in the device content index?" — the other
+        #: half of chain coverage; BlockPool installs it at wiring time
+        self.device_live: Callable[[Any], bool] = device_live or \
+            (lambda k: False)
+        self.tracer = tracer
+        self._lru: "OrderedDict[Any, Any]" = OrderedDict()
+        self._nbytes: Dict[Any, int] = {}
+        #: key -> the SAME key object: the intern table behind
+        #: :meth:`canonical` (dicts cannot hand back their stored key)
+        self._canon: Dict[Any, Any] = {}
+        #: parent key -> host child keys (chain links inside the tier)
+        self._kids: Dict[Any, Set[Any]] = {}
+        self.bytes = 0
+        # monotone counters (the tier table / metrics rows)
+        self.demotions = 0     # pages accepted from the device LRU
+        self.promotions = 0    # entries consumed by a device-index commit
+        self.evictions = 0     # entries dropped for capacity (+ cascades)
+        self.rejected = 0      # put() refused (page larger than budget)
+
+    # -- introspection -------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def keys(self) -> List[Any]:
+        return list(self._lru)
+
+    def contains(self, key) -> bool:
+        """Peek (no LRU touch): admission and the fleet affinity probe
+        test reachability without committing to anything."""
+        return key in self._lru
+
+    def canonical(self, key):
+        """The STORED key object equal to ``key`` (None when absent).
+        ``BlockPool.canonical_key`` interns request chains against this
+        exactly as it does against the device index: without it a
+        request whose k-block prefix is host-resident would pay a full
+        O(depth) ChainKey chain walk on EVERY tier dict op (the
+        identity fast path never fires on fresh key objects) — the
+        quadratic admission blowup interning exists to prevent."""
+        return self._canon.get(key)
+
+    # -- transitions ---------------------------------------------------
+
+    def _link(self, key) -> None:
+        prev = getattr(key, "prev", None)
+        if prev is not None:
+            self._kids.setdefault(prev, set()).add(key)
+
+    def _unlink(self, key) -> None:
+        prev = getattr(key, "prev", None)
+        if prev is not None:
+            kids = self._kids.get(prev)
+            if kids is not None:
+                kids.discard(key)
+                if not kids:
+                    del self._kids[prev]
+
+    def put(self, key, payload) -> bool:
+        """Demote one page into the tier. Returns False only when the
+        page alone exceeds the whole byte budget (the caller then treats
+        the eviction as a plain drop and cascades). Re-demoting a key
+        refreshes its recency and payload."""
+        nb = payload_nbytes(payload)
+        if self.max_bytes is not None and nb > self.max_bytes:
+            self.rejected += 1
+            return False
+        if key in self._lru:
+            self.bytes -= self._nbytes[key]
+            self._lru[key] = payload
+            self._lru.move_to_end(key)
+        else:
+            self._lru[key] = payload
+            self._canon[key] = key
+            self._link(key)
+        self._nbytes[key] = nb
+        self.bytes += nb
+        self.demotions += 1
+        self._shrink(protect=key)
+        return True
+
+    def get(self, key):
+        """Payload for a host-matched key (None when absent), refreshing
+        its recency. The payload reference stays valid even if the entry
+        is later evicted — promotion captures it here, so an LRU race
+        can never corrupt an in-flight transfer."""
+        payload = self._lru.get(key)
+        if payload is not None:
+            self._lru.move_to_end(key)
+        return payload
+
+    def evict(self, key) -> bool:
+        """Drop one entry because the device index now holds its content
+        (promotion consumed it, or a recompute re-created it — the
+        single-residency rule either way); cascades onto host children
+        left with no covered parent. Returns False when absent
+        (idempotent)."""
+        out = self._evict(key, count_eviction=False)
+        if out:
+            self.promotions += 1
+        return out
+
+    def _evict(self, key, count_eviction: bool) -> bool:
+        if key not in self._lru:
+            return False
+        self._drop_one(key, count_eviction)
+        self._cascade(key)
+        return True
+
+    def _drop_one(self, key, count_eviction: bool) -> None:
+        self.bytes -= self._nbytes.pop(key)
+        del self._lru[key]
+        del self._canon[key]
+        self._unlink(key)
+        if count_eviction:
+            self.evictions += 1
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant("host_tier_evict", cat="pool",
+                                args={"entries": len(self._lru)})
+
+    def _cascade(self, parent) -> None:
+        """After ``parent`` left the tier: host children whose chain is
+        now covered by neither tier are unreachable forever (matching
+        stops at the gap) — drop them too, transitively, so no entry is
+        ever stranded. Iterative worklist: a 3000-block chain (a
+        ~48k-token prompt) must cascade without touching the recursion
+        limit."""
+        work = [parent]
+        while work:
+            gone = work.pop()
+            if self.device_live(gone):
+                continue  # chain still covered through the device index
+            for child in list(self._kids.get(gone, ())):
+                if child in self._lru:
+                    self._drop_one(child, count_eviction=True)
+                    work.append(child)
+
+    def on_device_drop(self, key) -> None:
+        """The device index lost ``key`` WITHOUT demoting it here (spill
+        disabled for that eviction, or :meth:`put` rejected the page):
+        host children it covered must cascade."""
+        if key not in self._lru:
+            self._cascade(key)
+
+    def _shrink(self, protect=None) -> None:
+        while self._lru and self._over_budget() and \
+                (len(self._lru) > 1 or next(iter(self._lru)) is not protect):
+            oldest = next(iter(self._lru))
+            if oldest is protect:
+                # never evict the page being inserted; take the next-oldest
+                oldest = next(k for k in self._lru if k is not protect)
+            self._evict(oldest, count_eviction=True)
+
+    def _over_budget(self) -> bool:
+        if self.max_blocks and len(self._lru) > self.max_blocks:
+            return True
+        return self.max_bytes is not None and self.bytes > self.max_bytes
+
+    def clear(self) -> int:
+        """Drop EVERY entry — host memory dies with the process, so a
+        replica kill clears this tier along with the device LRU (a
+        revived replica re-warms from traffic, never resurrects pre-kill
+        pages). Returns the count."""
+        n = len(self._lru)
+        self._lru.clear()
+        self._nbytes.clear()
+        self._canon.clear()
+        self._kids.clear()
+        self.bytes = 0
+        return n
+
+    # -- invariants ----------------------------------------------------
+
+    def check(self, device_live: Optional[Callable[[Any], bool]] = None
+              ) -> None:
+        """Tier-internal consistency: byte accounting exact, chain links
+        bijective with entries, and NO stranded entry (every host key's
+        parent is host-live or device-live). Raises RuntimeError on any
+        violation — called by ``BlockPool.check_consistent``."""
+        live = device_live or self.device_live
+        if set(self._lru) != set(self._nbytes) or \
+                set(self._lru) != set(self._canon):
+            raise RuntimeError("host tier LRU / byte accounting diverged")
+        if self.bytes != sum(self._nbytes.values()):
+            raise RuntimeError(
+                f"host tier byte gauge {self.bytes} != "
+                f"{sum(self._nbytes.values())} (sum of entries)")
+        for parent, kids in self._kids.items():
+            for child in kids:
+                if child not in self._lru:
+                    raise RuntimeError(
+                        f"host tier chain link to dead entry {child!r}")
+        for key in self._lru:
+            prev = getattr(key, "prev", None)
+            if prev is None:
+                continue
+            if prev not in self._lru and not live(prev):
+                raise RuntimeError(
+                    f"stranded host page {key!r}: chain parent in "
+                    f"neither tier (unreachable by any prefix match)")
+
+    def stats(self) -> Dict[str, Any]:
+        """One tier-table row (CLI reports, /statusz, bench artifacts)."""
+        return {
+            "tier": "host",
+            "capacity_blocks": self.max_blocks or None,
+            "capacity_bytes": self.max_bytes,
+            "blocks": len(self._lru),
+            "bytes": self.bytes,
+            "demotions": self.demotions,
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+            "rejected": self.rejected,
+        }

@@ -76,6 +76,31 @@ class ServingMetrics:
     prefix_hits: int = 0
     #: copy-on-write page forks (appends routed off shared pages)
     cow_copies: int = 0
+    # -- tiered KV (kv_tiers.HostTier behind the BlockPool) -------------
+    #: admissions whose prefix match extended into the HOST tier (>=1
+    #: host-resident block scheduled for promotion)
+    kv_host_hits: int = 0
+    #: tier-enabled admissions whose match ended at the device boundary
+    #: (nothing promotable on the host) — hits + misses = probed
+    #: admissions, the denominator of the host-tier usefulness story
+    kv_host_misses: int = 0
+    #: prompt tokens served from HOST-tier pages (a subset of
+    #: ``cached_prefill_tokens`` — host hits are cache hits whose KV
+    #: streams up instead of recomputing)
+    kv_host_hit_tokens: int = 0
+    #: pages demoted device -> host (evictions that preserved the chain)
+    kv_pages_demoted: int = 0
+    #: promotions folded into the device pool (host -> device)
+    kv_pages_promoted: int = 0
+    #: scheduled promotions dropped before folding (their request was
+    #: preempted / cancelled / failed while the transfer was in flight)
+    kv_promote_cancelled: int = 0
+    # gauges (overwritten each step while a tier is attached)
+    #: host-tier entries / bytes right now
+    kv_host_blocks: int = 0
+    kv_host_bytes: int = 0
+    #: promotions still in flight (scheduled, not yet folded)
+    promote_queue_depth: int = 0
     tokens_generated: int = 0
     # -- speculative decoding (the verify rows of the mixed step) -------
     #: draft tokens packed into verify rows (accepted or not — the
@@ -178,6 +203,11 @@ class ServingMetrics:
             "ttft_s", lo=1e-5, hi=4e3)
         self.step_hist: Histogram = self.registry.histogram(
             "step_s", lo=1e-5, hi=4e3)
+        #: schedule -> fold latency of host-tier promotions (the number
+        #: the "promotion hidden behind suffix prefill" claim is judged
+        #: on); rides the registry so /metrics exports the buckets
+        self.promote_hist: Histogram = self.registry.histogram(
+            "kv_promote_wait_s", lo=1e-6, hi=4e3)
         #: rolling SLO window: 1 per non-good terminal, 0 per good — the
         #: burn-rate gauge is its mean (bounded memory, recovers as good
         #: traffic pushes bad verdicts out). The /metrics scrape thread
@@ -233,6 +263,14 @@ class ServingMetrics:
     def prefix_hit_rate(self) -> float:
         """Fraction of served prefill tokens that came from the cache."""
         return self.cached_prefill_tokens / self.prefill_tokens \
+            if self.prefill_tokens else 0.0
+
+    @property
+    def host_hit_rate(self) -> float:
+        """Fraction of served prefill tokens that came from the HOST
+        tier specifically — the tier's own contribution on top of the
+        device cache (0 with the tier off or never hit)."""
+        return self.kv_host_hit_tokens / self.prefill_tokens \
             if self.prefill_tokens else 0.0
 
     @property
@@ -302,6 +340,16 @@ class ServingMetrics:
             "prefix_evictions": float(self.prefix_evictions),
             "kv_blocks_cached": float(self.blocks_cached),
             "cow_copies": float(self.cow_copies),
+            "kv_host_hits": float(self.kv_host_hits),
+            "kv_host_misses": float(self.kv_host_misses),
+            "kv_host_hit_tokens": float(self.kv_host_hit_tokens),
+            "host_hit_rate": self.host_hit_rate,
+            "kv_pages_demoted": float(self.kv_pages_demoted),
+            "kv_pages_promoted": float(self.kv_pages_promoted),
+            "kv_promote_cancelled": float(self.kv_promote_cancelled),
+            "kv_host_blocks": float(self.kv_host_blocks),
+            "kv_host_bytes": float(self.kv_host_bytes),
+            "promote_queue_depth": float(self.promote_queue_depth),
             "prefill_waiting": float(self.prefill_waiting),
             "prefill_queue_age_s": self.prefill_queue_age_s,
             "requests_submitted": float(self.requests_submitted),
@@ -352,6 +400,9 @@ class ServingMetrics:
             out["step_p50_s"] = self.step_hist.percentile(0.5)
             out["step_p95_s"] = self.step_hist.percentile(0.95)
             out["step_p99_s"] = self.step_hist.percentile(0.99)
+        if self.promote_hist.count:
+            out["kv_promote_wait_p50_s"] = self.promote_hist.percentile(0.5)
+            out["kv_promote_wait_p95_s"] = self.promote_hist.percentile(0.95)
         return out
 
     def to_events(self, step: int):

@@ -137,9 +137,14 @@ class Replica:
                             hashes: List[ChainKey]) -> int:
         """Tokens of ``tokens`` this replica's content index can serve
         from cached KV — exactly what admission would match (the
-        at-least-one-computed-token cap included)."""
+        at-least-one-computed-token cap included), across the WHOLE
+        tier ladder: a replica holding a tenant's prefix in host RAM
+        serves it nearly as well as one holding it in HBM (promotion
+        streams up behind the suffix prefill) and far better than a
+        cold one, so the affinity probe counts host-tier matches too."""
         pool = self.engine.block_pool
-        return len(pool.match_prefix(tokens, hashes)) * pool.block_size
+        dev, host = pool.tiered_match_blocks(len(tokens), hashes)
+        return (dev + host) * pool.block_size
 
     def prefix_index_blocks(self) -> int:
         """Size of the content index (live hashed pages) — the fleet
@@ -214,6 +219,8 @@ class Replica:
             "ready_reasons": self.ready_reasons(),
             **self.signals(),
             "prefix_index_blocks": self.prefix_index_blocks(),
+            "host_tier_blocks": len(self.engine.host_tier)
+            if self.engine.host_tier is not None else 0,
             "goodput_tokens": m.goodput_tokens,
             "slo_verdicts": {"good": m.slo_good,
                              "ttft_miss": m.slo_ttft_miss,

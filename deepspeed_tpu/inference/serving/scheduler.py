@@ -78,8 +78,25 @@ class Request:
     blocks: List[int] = field(default_factory=list)
     seq_len: int = 0          # tokens whose KV sits in the pool
     #: tokens served from the prefix cache at the LATEST admission (their
-    #: KV was never recomputed); block-aligned by construction
+    #: KV was never recomputed); block-aligned by construction. Includes
+    #: host-tier hits (their KV streams up instead of recomputing)
     prefix_len: int = 0
+    #: tokens of ``prefix_len`` matched in the HOST tier at the latest
+    #: admission (block-aligned; the tail of the cached prefix)
+    host_prefix_len: int = 0
+    #: host-tier admission hits awaiting promotion scheduling:
+    #: ``(block_idx, chain_key, payload)`` per matched block — the
+    #: scheduler (jax-free) captures the payload references; the ENGINE
+    #: consumes this list right after admission, device_puts the
+    #: payloads onto its promotion queue and clears it
+    host_hits: List[tuple] = field(default_factory=list)
+    #: scheduled promotions that have not folded into the device pool
+    #: yet. While nonzero the request receives NO prefill grants — its
+    #: suffix chunks would attend pages whose KV is still in flight —
+    #: but the PACKED step never waits: everyone else plans and
+    #: dispatches as usual (the "blocks only that request's next grant"
+    #: rule)
+    promote_pending: int = 0
     #: resume tokens whose KV is in the pool so far — between admission and
     #: the last prefill chunk this trails ``prefill_target`` and the
     #: request sits in a slot WITHOUT decoding (chunked prefill)
@@ -350,10 +367,31 @@ class Scheduler:
             if matched:
                 self.pool.free(matched, req.rid)
             return None
+        host_keys: List[ChainKey] = []
+        if self.prefix_cache and self.pool.host_tier is not None:
+            # extend the match into the HOST tier (contiguous from the
+            # device boundary). Payloads are captured NOW — a host LRU
+            # eviction between here and the promotion fold can then
+            # never lose content admission already promised. These
+            # blocks charge device headroom like fresh allocations
+            # (they come out of the allocate() below) until promoted —
+            # the admission-charge rule the headroom gate also applies.
+            for h in self.pool.host_match_keys(len(tokens),
+                                               req.block_hashes,
+                                               len(matched)):
+                payload = self.pool.host_tier.get(h)
+                if payload is None:
+                    break  # raced an eviction: the run ends here
+                host_keys.append((h, payload))
         self.queue.popleft()
         req.blocks = matched + self.pool.allocate(need_total - len(matched),
                                                   req.rid)
-        req.prefix_len = len(matched) * self.pool.block_size
+        bs = self.pool.block_size
+        req.prefix_len = (len(matched) + len(host_keys)) * bs
+        req.host_prefix_len = len(host_keys) * bs
+        req.host_hits = [(len(matched) + j, h, payload)
+                         for j, (h, payload) in enumerate(host_keys)]
+        req.promote_pending = len(host_keys)
         req.prefill_done = req.prefix_len
         req.prefill_target = len(tokens)
         req.seq_len = req.prefix_len
@@ -368,6 +406,7 @@ class Scheduler:
             self.tracer.instant("admit", cat="sched",
                                 args={"rid": req.rid,
                                       "prefix_tokens": req.prefix_len,
+                                      "host_tokens": req.host_prefix_len,
                                       "queue_depth": len(self.queue)})
         self.slots[slot] = req
         self.admit_log.append(req.rid)
@@ -390,7 +429,13 @@ class Scheduler:
         grants: Dict[str, int] = {}
         if budget <= 0 or chunk <= 0:
             return grants
-        pending = sorted((r for _, r in self.active() if r.prefilling),
+        # promotion-blocked residents are skipped, not waited for: their
+        # next suffix chunk would attend host-matched pages whose KV is
+        # still streaming up, so granting them would poison attention —
+        # withholding THEIR grant is the only cost an unlanded promotion
+        # may impose; the packed step itself never blocks on a transfer
+        pending = sorted((r for _, r in self.active()
+                          if r.prefilling and not r.promote_pending),
                          key=lambda r: r.admit_order)
         while budget > 0:
             progressed = False
@@ -456,6 +501,14 @@ class Scheduler:
         req.slot = None
         req.seq_len = 0
         req.prefix_len = 0
+        req.host_prefix_len = 0
+        # in-flight promotions die with the admission segment: the pages
+        # they target just returned to the pool, so the engine's pump
+        # drops their queue entries (validity = this request's CURRENT
+        # admission stamp + block ids); re-admission re-matches the host
+        # tier, whose entries were not consumed (commit never ran)
+        req.host_hits = []
+        req.promote_pending = 0
         req.prefill_done = 0
         req.prefill_target = 0
         req.committed_blocks = 0

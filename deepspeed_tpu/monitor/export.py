@@ -466,6 +466,10 @@ def serving_statusz(srv) -> str:
     lines.append("")
     lines.append(f"compile_counts: {json.dumps(perf.get('compile_counts'))}")
     lines.append("")
+    tiers = srv.tier_status()
+    if tiers.get("enabled"):
+        lines.append(f"kv_tiers: {json.dumps(tiers['tiers'])}")
+        lines.append("")
     lines.append("metrics snapshot:")
     for k, v in sorted(srv.metrics.snapshot().items()):
         lines.append(f"  {k} = {v:g}")

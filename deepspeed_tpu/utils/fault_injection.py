@@ -34,6 +34,19 @@ Serving chaos vocabulary (injection points in ``serving/engine.py``)::
                                              # router step 30 (the
                                              # ServingRouter requeues its
                                              # in-flight requests)
+    DS_FAULT=slow_promote:seconds=1:tag=serving_tier
+                                             # a host->device KV promotion
+                                             # fold wedges INSIDE the
+                                             # watchdog-guarded region (the
+                                             # step watchdog fails ITS
+                                             # request, serving continues)
+    DS_FAULT=corrupt_promote:fails=1:tag=serving_tier
+                                             # NaN one promoted page's
+                                             # payload in transit — the
+                                             # logit guard quarantines the
+                                             # request BEFORE the page is
+                                             # content-re-indexed; the
+                                             # clean host copy survives
 
 Recognized match keys: ``step`` / ``rank`` / ``tag`` (spec fires only when
 the injection point reports a matching value), ``fails`` (bounded faults:

@@ -181,6 +181,30 @@ def test_spec_tokens_demo_reports_speculation(tmp_path):
     assert "spec_acc=" in r.stderr, "stats line must carry acceptance"
 
 
+def test_host_cache_demo_reports_tier_table(tmp_path):
+    """--host-cache-blocks end-to-end: the demo serves with the host
+    spill tier armed (implying --prefix-cache), the stats line carries
+    host_hit_rate/promote_q, and the final report's kv_tiers block
+    lists both tiers with the movement counters."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+         "--demo", "6", "--cpu", "--host-cache-blocks", "64",
+         "--num-blocks", "32", "--stats-interval-s", "0.2"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert "host_hit_rate=" in r.stderr and "promote_q=" in r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    final = recs[-1]
+    tiers = final["kv_tiers"]
+    assert tiers["enabled"] is True
+    assert [t["tier"] for t in tiers["tiers"]] == ["device", "host"]
+    assert tiers["tiers"][1]["capacity_blocks"] == 64
+    snap = final["serving_metrics"]
+    assert "kv_host_blocks" in snap and "host_hit_rate" in snap
+    assert final["serving_metrics"]["compile_counts"] == {"mixed_step": 1}
+
+
 def test_demo_cannot_mix_with_prompts(tmp_path):
     p = tmp_path / "p.jsonl"
     p.write_text('{"prompt_ids": [1]}\n')

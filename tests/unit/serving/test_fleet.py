@@ -213,6 +213,39 @@ def test_killed_replica_auto_revives_and_serves(engine):
     assert_fleet_invariant(router)
 
 
+def test_kill_clears_host_tier_revive_rewarns_from_traffic(engine):
+    """Kill clears BOTH cache tiers: host memory dies with the process,
+    so a revived replica must re-warm from traffic — the same-prefix
+    request after revive MISSES the host tier (recompute, still served),
+    and only fresh churn repopulates it. Without the fix a revived
+    replica would resurrect pre-kill host pages no real restart could
+    ever have."""
+    router = fleet(engine, 1, rcfg=RouterConfig(revive_after_steps=2),
+                   num_blocks=16, max_model_len=64, host_cache_blocks=64)
+    rep = router.replicas[0]
+    rs = np.random.RandomState(41)
+    prefix = rs.randint(1, VOCAB, 24)          # 3 full blocks
+    _serve_one(router, np.concatenate([prefix, rs.randint(1, VOCAB, 8)]))
+    for _ in range(6):                         # churn -> demotions
+        _serve_one(router, rs.randint(1, VOCAB, 32), 2)
+    assert len(rep.engine.host_tier) > 0
+    assert rep.engine.block_pool.demotions > 0
+    router.kill_replica(0)
+    assert len(rep.engine.host_tier) == 0      # died with the process
+    assert rep.engine.block_pool.cached_count == 0
+    rep.engine.block_pool.check_consistent()
+    router.revive_replica(0)
+    hits0 = rep.engine.metrics.kv_host_hits
+    out = _serve_one(router, np.concatenate([prefix,
+                                             rs.randint(1, VOCAB, 8)]))
+    assert out.state == "finished"
+    assert rep.engine.metrics.kv_host_hits == hits0  # MISS: no resurrection
+    for _ in range(6):                         # re-warm from traffic
+        _serve_one(router, rs.randint(1, VOCAB, 32), 2)
+    assert len(rep.engine.host_tier) > 0
+    assert_fleet_invariant(router)
+
+
 def test_ds_fault_replica_kill_chaos_point(engine, monkeypatch):
     """``DS_FAULT=replica_kill:step=N[:replica=K]`` drives the kill from
     the chaos vocabulary — the storm drill's trigger."""

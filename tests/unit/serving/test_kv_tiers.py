@@ -1,0 +1,542 @@
+"""Tiered KV cache (serving/kv_tiers.py): demotion behind the pool's
+LRU, cross-tier prefix matching, async promotion overlapping the suffix
+prefill, the tier chaos vocabulary (slow_promote / corrupt_promote), and
+the cross-tier consistency law — no dual residency, no stranded host
+pages, zero leaks, ONE resident compile throughout.
+
+Compile budget: engine-level tests share one tiered prefix-cache engine
+(module fixture, no watchdog) plus ONE watchdog-armed tiered engine for
+the slow_promote drill; every test drains its engine and asserts the
+cross-tier invariant.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+from deepspeed_tpu.inference.serving.block_pool import (BlockPool,
+                                                        BlockPoolError)
+from deepspeed_tpu.inference.serving.kv_tiers import (HostTier,
+                                                      payload_nbytes)
+from deepspeed_tpu.utils import fault_injection
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# tier-level (pure host accounting, no jax)
+# ---------------------------------------------------------------------------
+
+
+class _Key:
+    """ChainKey stand-in: hashable, with a ``prev`` chain link."""
+
+    def __init__(self, name, prev=None):
+        self.name, self.prev = name, prev
+
+    def __repr__(self):
+        return f"_Key({self.name})"
+
+
+def _pl(n=8):
+    return {"k": np.zeros((2, 1, n), np.float32)}
+
+
+def test_host_tier_lru_block_and_byte_budgets():
+    t = HostTier(max_blocks=2)
+    a, b, c = _Key("a"), _Key("b"), _Key("c")
+    assert t.put(a, _pl()) and t.put(b, _pl())
+    assert t.contains(a) and len(t) == 2
+    t.get(a)                       # refresh: b is now LRU
+    assert t.put(c, _pl())
+    assert not t.contains(b) and t.contains(a) and t.contains(c)
+    assert t.evictions == 1 and t.demotions == 3
+    assert t.bytes == 2 * payload_nbytes(_pl())
+    t.check()
+    # byte budget: a page larger than the whole budget is rejected
+    tb = HostTier(max_bytes=payload_nbytes(_pl()) + 1)
+    assert not tb.put(a, _pl(1000)) and tb.rejected == 1
+    assert tb.put(a, _pl())
+    assert tb.put(b, _pl()) and not tb.contains(a)  # byte-evicted LRU
+    tb.check()
+    with pytest.raises(ValueError):
+        HostTier()                 # a tier needs SOME capacity
+
+
+def test_host_tier_capacity_eviction_cascades_orphaned_chain():
+    """Evicting a chain's head for capacity drops host children the gap
+    orphans (they could never be matched again) — unless the parent is
+    still live in the DEVICE index, in which case the chain stays
+    covered and the children stay."""
+    t = HostTier(max_blocks=8)
+    a = _Key("a")
+    b = _Key("b", prev=a)
+    c = _Key("c", prev=b)
+    for k in (a, b, c):
+        t.put(k, _pl())
+    t._evict(a, count_eviction=True)   # capacity-style eviction
+    assert len(t) == 0                 # b, c cascaded (stranded otherwise)
+    t.check()
+    # same shape, but the parent stays device-live: children survive
+    t2 = HostTier(max_blocks=8, device_live=lambda k: k.name == "a")
+    t2.put(b, _pl())
+    t2.put(c, _pl())
+    t2.on_device_drop(a)               # device dropped it... not really
+    assert t2.contains(b) and t2.contains(c)
+    t2.check()
+
+
+def test_pool_eviction_demotes_and_match_extends_across_tiers():
+    pool = BlockPool(4, 4)
+    tier = HostTier(max_blocks=16)
+    store = {0: _pl(), 1: _pl(), 2: _pl(), 3: _pl()}
+    pool.attach_host_tier(tier, lambda bids: [store[b] for b in bids])
+    tokens = list(range(1, 13))        # 3 full blocks
+    hashes = pool.prefix_block_hashes(tokens)
+    blocks = pool.allocate(3, "a")
+    for bid, h in zip(blocks, hashes):
+        pool.commit_hash(bid, h)
+    pool.free(blocks, "a")
+    # demand forces the whole chain off the device LRU -> host tier
+    bb = pool.allocate(4, "b")
+    assert pool.demotions == 3 and len(tier) == 3
+    pool.free(bb, "b")                 # unhashed -> blank, not cached
+    assert pool.match_prefix(tokens, hashes) == []       # device: gone
+    assert pool.tiered_match_blocks(len(tokens) + 1, hashes) == (0, 3)
+    # the at-least-one-computed-token cap applies across tiers too
+    assert pool.tiered_match_blocks(len(tokens), hashes) == (0, 2)
+    assert pool.host_match_keys(len(tokens) + 1, hashes, 0) == hashes
+    pool.check_consistent()
+    # re-indexing a key on device CONSUMES the host entry (single
+    # residency) without cascading its still-covered children
+    [nb] = pool.allocate(1, "c")
+    pool.commit_hash(nb, hashes[0])
+    assert not tier.contains(hashes[0]) and tier.contains(hashes[1])
+    assert tier.promotions == 1
+    pool.check_consistent()
+    pool.free([nb], "c")
+
+
+def test_drop_cached_clears_both_tiers_without_demoting():
+    pool = BlockPool(4, 4)
+    tier = HostTier(max_blocks=16)
+    pool.attach_host_tier(tier, lambda bids: [_pl() for _ in bids])
+    blocks = pool.allocate(2, "a")
+    tokens = list(range(1, 9))
+    for bid, h in zip(blocks, pool.prefix_block_hashes(tokens)):
+        pool.commit_hash(bid, h)
+    pool.free(blocks, "a")
+    pool.allocate(3, "b")              # one page demotes
+    assert len(tier) == 1
+    demotions = pool.demotions
+    assert pool.drop_cached() == 1     # the still-cached page
+    assert len(tier) == 0              # host memory died with the process
+    assert pool.demotions == demotions  # a kill demotes NOTHING
+    pool.check_consistent()
+
+
+def test_check_consistent_catches_dual_residency_and_stranding():
+    pool = BlockPool(4, 4)
+    tier = HostTier(max_blocks=16)
+    pool.attach_host_tier(tier, lambda bids: [_pl() for _ in bids])
+    tokens = list(range(1, 9))
+    hashes = pool.prefix_block_hashes(tokens)
+    blocks = pool.allocate(2, "a")
+    for bid, h in zip(blocks, hashes):
+        pool.commit_hash(bid, h)
+    pool.check_consistent()
+    # plant dual residency: the key is live on device AND on the host LRU
+    tier._lru[hashes[0]] = _pl()
+    tier._nbytes[hashes[0]] = payload_nbytes(_pl())
+    tier._canon[hashes[0]] = hashes[0]
+    tier.bytes += payload_nbytes(_pl())
+    with pytest.raises(BlockPoolError, match="BOTH tiers"):
+        pool.check_consistent()
+    tier._evict(hashes[0], count_eviction=False)
+    pool.free(blocks, "a")
+    pool.check_consistent()
+    # plant a stranded entry: a host page whose chain parent is in
+    # neither tier is unreachable by any prefix match
+    orphan = pool.prefix_block_hashes(list(range(50, 62)))
+    tier._lru[orphan[1]] = _pl()
+    tier._nbytes[orphan[1]] = payload_nbytes(_pl())
+    tier._canon[orphan[1]] = orphan[1]
+    tier.bytes += payload_nbytes(_pl())
+    tier._link(orphan[1])
+    with pytest.raises(BlockPoolError, match="stranded"):
+        pool.check_consistent()
+
+
+# ---------------------------------------------------------------------------
+# engine-level: demote -> host hit -> async promotion
+# ---------------------------------------------------------------------------
+
+
+MAX_DRAIN_STEPS = 400
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+@pytest.fixture(scope="module")
+def srv_tier(llama_engine):
+    """Shared tiered engine: tiny device pool (24 pages) behind a host
+    tier big enough that churn demotes instead of destroying."""
+    return ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=4, block_size=8, num_blocks=24, max_model_len=64,
+        prefix_cache=True, prefill_chunk_tokens=16, host_cache_blocks=96))
+
+
+def _drain(srv):
+    steps = 0
+    while srv.has_work():
+        srv.step()
+        steps += 1
+        assert steps < MAX_DRAIN_STEPS, "tiered engine wedged"
+
+
+def _invariant(srv):
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+    assert srv.compile_counts == {"mixed_step": 1}, srv.compile_counts
+    assert srv.perf.recompile_total == 0
+
+
+def _one(srv, prompt, n=6):
+    rid = srv.submit(prompt, max_new_tokens=n)
+    _drain(srv)
+    out = srv.poll(rid)
+    srv.forget(rid)
+    return out
+
+
+def _reference(engine, prompt, n):
+    return [int(t) for t in np.asarray(engine.generate(
+        np.asarray(prompt)[None], max_new_tokens=n, do_sample=False))[0]]
+
+
+def _churn(srv, rs, n=8):
+    """Unrelated traffic that rolls the device LRU over -> demotions."""
+    vocab = 256
+    for _ in range(n):
+        out = _one(srv, rs.randint(1, vocab, 40), 4)
+        assert out.state == "finished", out
+
+
+def test_acceptance_host_hit_token_identical_one_compile(
+        srv_tier, llama_engine):
+    """THE tier acceptance test: a prefix evicted to the HOST tier is
+    matched there, promoted asynchronously, and served token-identically
+    to uncached generate — with the ONE resident mixed-step compile and
+    no dual residency anywhere."""
+    srv = srv_tier
+    rs = np.random.RandomState(3)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 32)          # 4 full blocks
+    p1 = np.concatenate([prefix, rs.randint(1, vocab, 8)])
+    out = _one(srv, p1)
+    assert out.state == "finished"
+    assert out.tokens == _reference(llama_engine, p1, 6)
+    _churn(srv, rs)
+    assert srv.block_pool.demotions > 0 and len(srv.host_tier) > 0
+    assert srv.metrics.kv_pages_demoted > 0
+    # replay behind the same prefix: device index lost it, host has it
+    m = srv.metrics
+    hits0, prom0 = m.kv_host_hits, m.kv_pages_promoted
+    p2 = np.concatenate([prefix, rs.randint(1, vocab, 8)])
+    out2 = _one(srv, p2)
+    assert out2.state == "finished"
+    assert out2.tokens == _reference(llama_engine, p2, 6)
+    assert m.kv_host_hits == hits0 + 1
+    assert m.kv_pages_promoted >= prom0 + 4    # the whole 4-block prefix
+    assert m.kv_host_hit_tokens >= 32
+    assert m.promote_hist.count >= 1           # wait histogram observed
+    assert m.host_hit_rate > 0
+    _invariant(srv)
+
+
+def test_unlanded_promotion_blocks_only_its_own_grants(
+        srv_tier, llama_engine, monkeypatch):
+    """While a request's promotions are in flight it receives NO prefill
+    grants (its chunks would attend pages whose KV is still streaming
+    up) — but everyone else keeps stepping: the packed step never waits
+    on a transfer."""
+    import deepspeed_tpu.inference.serving.engine as eng_mod
+
+    srv = srv_tier
+    rs = np.random.RandomState(7)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 32)
+    _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]))
+    _churn(srv, rs)
+    assert len(srv.host_tier) > 0
+    # transfers "never land" while the patch is in place. The companion
+    # request must OUTLIVE the gated window: with no other runnable
+    # work the engine legitimately BLOCKS on the transfer instead
+    # (promotions-only wait — an empty packed step is free to spend)
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: False)
+    rid = srv.submit(np.concatenate([prefix, rs.randint(1, vocab, 8)]),
+                     max_new_tokens=4)
+    other = srv.submit(rs.randint(1, vocab, 8), max_new_tokens=32)
+    for _ in range(6):
+        srv.step()
+    req = srv.request(rid)
+    assert req.promote_pending > 0
+    assert req.prefill_done == req.prefix_len   # not one suffix grant
+    assert srv.metrics.promote_queue_depth > 0
+    # the OTHER request kept decoding meanwhile: the packed step never
+    # waited on the stuck transfer
+    assert len(srv.request(other).tokens) >= 4
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: True)
+    _drain(srv)
+    out = srv.poll(rid)
+    assert out.state == "finished"
+    srv.forget(rid)
+    srv.forget(other)
+    _invariant(srv)
+
+
+def test_cancel_mid_promotion_drops_entries_keeps_host_copy(
+        srv_tier, llama_engine, monkeypatch):
+    """A request cancelled while its promotions are in flight: the queue
+    entries are dropped (their target pages are back in the pool), the
+    HOST copies survive (commit never ran), and a replay hits them
+    again — nothing leaks, nothing strands."""
+    import deepspeed_tpu.inference.serving.engine as eng_mod
+
+    srv = srv_tier
+    rs = np.random.RandomState(11)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 32)
+    _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]))
+    _churn(srv, rs)
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: False)
+    rid = srv.submit(np.concatenate([prefix, rs.randint(1, vocab, 8)]),
+                     max_new_tokens=4)
+    # a companion keeps the engine off the promotions-only wait path
+    # (with nothing else runnable it would block on — and fold — the
+    # "stuck" transfer instead of leaving it pending)
+    other = srv.submit(rs.randint(1, vocab, 8), max_new_tokens=32)
+    srv.step()
+    assert srv.request(rid).promote_pending > 0
+    host_keys = set(srv.host_tier.keys())
+    cancelled0 = srv.metrics.kv_promote_cancelled
+    srv.cancel(rid)
+    srv.step()                                  # pump drops the entries
+    assert srv.metrics.kv_promote_cancelled > cancelled0
+    assert srv.metrics.promote_queue_depth == 0
+    assert set(srv.host_tier.keys()) == host_keys  # copies survive
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: True)
+    _drain(srv)
+    srv.forget(other)
+    srv.forget(rid)
+    m = srv.metrics
+    hits0 = m.kv_host_hits
+    out = _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]))
+    assert out.state == "finished" and m.kv_host_hits == hits0 + 1
+    _invariant(srv)
+
+
+def test_defrag_remaps_inflight_promotions(srv_tier, llama_engine,
+                                           monkeypatch):
+    """defrag() rewrites block tables by id — in-flight promotion
+    entries must be remapped with them, or the pump would drop them as
+    stale and leave their request promotion-blocked (no grants) with no
+    promotion ever coming."""
+    import deepspeed_tpu.inference.serving.engine as eng_mod
+
+    srv = srv_tier
+    rs = np.random.RandomState(29)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 32)
+    p = np.concatenate([prefix, rs.randint(1, vocab, 8)])
+    ref = _one(srv, p).tokens
+    _churn(srv, rs)
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: False)
+    rid = srv.submit(np.concatenate([prefix, rs.randint(1, vocab, 8)]),
+                     max_new_tokens=4)
+    other = srv.submit(rs.randint(1, vocab, 8), max_new_tokens=32)
+    srv.step()
+    assert srv.request(rid).promote_pending > 0
+    srv.defrag()                                # remaps blocks AND queue
+    monkeypatch.setattr(eng_mod, "_tree_ready", lambda tree: True)
+    _drain(srv)
+    out = srv.poll(rid)
+    assert out.state == "finished"
+    assert srv.request(rid).preemptions == 0    # remap, not the safety net
+    srv.forget(rid)
+    srv.forget(other)
+    # and the promoted content is CORRECT post-defrag: the same prompt
+    # replays token-identically
+    assert _one(srv, p, 6).tokens[:4] == ref[:4]
+    _invariant(srv)
+
+
+def test_corrupt_promote_quarantined_before_reindex(
+        srv_tier, llama_engine, monkeypatch):
+    """``DS_FAULT=corrupt_promote:tag=serving_tier``: a page poisoned in
+    transit NaNs the request's first suffix chunk -> the existing logit
+    guard quarantines THAT request before any promoted page is
+    content-indexed. The clean host copies survive for the retry, which
+    serves the reference tokens."""
+    srv = srv_tier
+    rs = np.random.RandomState(13)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 32)
+    _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]))
+    _churn(srv, rs)
+    assert len(srv.host_tier) > 0
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "corrupt_promote:fails=1:tag=serving_tier")
+    fault_injection.reset()
+    try:
+        p = np.concatenate([prefix, rs.randint(1, vocab, 8)])
+        rid = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        out = srv.poll(rid)
+        assert out.state == "failed"
+        assert out.finish_reason == "corrupt_logits"
+        srv.forget(rid)
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    # poisoned pages were never indexed in EITHER tier's content index:
+    # the request's chain keys resolve to nothing on device...
+    hashes = srv.block_pool.prefix_block_hashes([int(t) for t in p])
+    assert all(srv.block_pool.lookup(h) is None for h in hashes)
+    _invariant(srv)
+    # ...and the retry host-hits the surviving clean copies
+    out2 = _one(srv, p, 4)
+    assert out2.state == "finished"
+    assert out2.tokens == _reference(llama_engine, p, 4)
+    _invariant(srv)
+
+
+def test_sync_promote_ab_control_token_identical(llama_engine):
+    """``sync_promote=True`` (the overlap benchmark's control arm) folds
+    at admission and must serve the same tokens as the async engine."""
+    outs = {}
+    for sync in (False, True):
+        srv = ServingEngine(llama_engine, ServingConfig(
+            max_batch_size=4, block_size=8, num_blocks=24,
+            max_model_len=64, prefix_cache=True, prefill_chunk_tokens=16,
+            host_cache_blocks=96, sync_promote=sync))
+        rs = np.random.RandomState(17)
+        vocab = llama_engine.module.config.vocab_size
+        prefix = rs.randint(1, vocab, 32)
+        _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]))
+        _churn(srv, rs)
+        p = np.concatenate([prefix, rs.randint(1, vocab, 8)])
+        outs[sync] = _one(srv, p).tokens
+        assert srv.metrics.kv_pages_promoted >= 4
+        _invariant(srv)
+    assert outs[True] == outs[False]
+
+
+def test_host_tier_requires_prefix_cache(llama_engine):
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ServingEngine(llama_engine, ServingConfig(host_cache_blocks=8))
+
+
+def test_slow_promote_bounded_by_step_watchdog(llama_engine, monkeypatch):
+    """``DS_FAULT=slow_promote:tag=serving_tier`` past the watchdog
+    budget: the wedged fold fails ITS request and the engine keeps
+    serving — zero leaks, zero strands, and the resident program never
+    recompiles. (First fold carries the scatter's compile and is exempt,
+    so the drill warms the promotion path first.)"""
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=16, max_model_len=48,
+        prefix_cache=True, prefill_chunk_tokens=16, host_cache_blocks=64,
+        step_watchdog_s=0.4))
+    rs = np.random.RandomState(19)
+    vocab = llama_engine.module.config.vocab_size
+    prefix = rs.randint(1, vocab, 24)
+
+    def warm_hit():
+        _one(srv, np.concatenate([prefix, rs.randint(1, vocab, 8)]), 2)
+        for _ in range(6):
+            _one(srv, rs.randint(1, vocab, 32), 2)   # churn -> demote
+        return _one(srv, np.concatenate([prefix,
+                                         rs.randint(1, vocab, 8)]), 2)
+
+    assert warm_hit().state == "finished"     # promotion path is warm
+    assert srv.metrics.kv_pages_promoted > 0
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "slow_promote:seconds=1.2:fails=1:tag=serving_tier")
+    fault_injection.reset()
+    try:
+        trips0 = srv.metrics.watchdog_trips
+        out = warm_hit()
+        assert out.state == "failed" and out.finish_reason == "step_watchdog"
+        assert srv.metrics.watchdog_trips == trips0 + 1
+    finally:
+        monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+        fault_injection.reset()
+    _drain(srv)
+    srv.block_pool.check_consistent()
+    assert srv.block_pool.used_count == 0
+    assert srv.compile_counts == {"mixed_step": 1}
+    assert srv.perf.recompile_total == 0
+    # recovery: fresh host-hit traffic completes
+    assert warm_hit().state == "finished"
+    srv.block_pool.check_consistent()
+
+
+@pytest.mark.chaos
+def test_tier_chaos_storm_zero_leaked_zero_stranded(llama_engine,
+                                                    monkeypatch):
+    """The tier chaos storm: probabilistic slow_promote + corrupt_promote
+    over host-hitting replay traffic. Every request terminal, zero
+    leaked pages, zero stranded host entries, one resident compile —
+    after EVERY fault type."""
+    srv = ServingEngine(llama_engine, ServingConfig(
+        max_batch_size=2, block_size=8, num_blocks=16, max_model_len=48,
+        prefix_cache=True, prefill_chunk_tokens=16, host_cache_blocks=64,
+        step_watchdog_s=0.4))
+    rs = np.random.RandomState(23)
+    vocab = llama_engine.module.config.vocab_size
+    tenants = [rs.randint(1, vocab, 24) for _ in range(3)]
+
+    def wave(n=6):
+        rids = [srv.submit(np.concatenate([tenants[i % 3],
+                                           rs.randint(1, vocab, 8)]),
+                           max_new_tokens=2) for i in range(n)]
+        _drain(srv)
+        return [srv.forget(r) for r in rids]
+
+    wave()                                     # seed + warm
+    for _ in range(4):
+        wave(2)
+    for spec in ("slow_promote:seconds=0.6:p=0.3:tag=serving_tier",
+                 "corrupt_promote:p=0.5:tag=serving_tier",
+                 "slow_promote:seconds=0.6:fails=1:tag=serving_tier,"
+                 "corrupt_promote:fails=1:tag=serving_tier"):
+        monkeypatch.setenv(fault_injection.ENV_VAR, spec)
+        fault_injection.reset()
+        try:
+            outs = wave(8)
+        finally:
+            monkeypatch.delenv(fault_injection.ENV_VAR, raising=False)
+            fault_injection.reset()
+        assert all(o.state in ("finished", "failed") for o in outs), \
+            [(o.state, o.finish_reason) for o in outs]
+        srv.block_pool.check_consistent()      # tiers included
+        assert srv.block_pool.used_count == 0
+        assert srv.metrics.promote_queue_depth == 0
+        assert srv.compile_counts == {"mixed_step": 1}
+        assert srv.perf.recompile_total == 0
+    # post-storm recovery wave must be clean
+    assert all(o.state == "finished" for o in wave(4))
+    srv.block_pool.check_consistent()

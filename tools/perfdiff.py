@@ -103,7 +103,18 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         # counts are configuration.
         "routed_", "requeued", ".kills", ".revives", "kill_steps",
         "verdicts.", "kv_pages_transferred", "disagg_hops",
-        "goodput_tokens", "post_storm", "storm.steps", ".replicas")
+        "goodput_tokens", "post_storm", "storm.steps", ".replicas",
+        # tiered-KV bookkeeping (r14): demote/promote/cancel counts are
+        # the WORKLOAD's page-movement volume (the gated signals are the
+        # hit rates — higher-is-better by name — the ttft_* legs and
+        # ttft_host_over_device_p50 below, all under lower-is-better
+        # rules; the tier bars themselves are asserted in-bench), and
+        # tenants / working-set / device-pool sizes are configuration.
+        # tier_storm trip/quarantine counts are the storm schedule's.
+        "pages_demoted", "pages_promoted", "promote_cancelled",
+        ".tenants", "working_set_blocks", "device_pool_blocks",
+        "host_hits", "tier_storm.watchdog_trips",
+        "tier_storm.logit_quarantines", "zero_leak", "zero_stranded")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
