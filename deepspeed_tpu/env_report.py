@@ -297,6 +297,33 @@ def kv_tier_report() -> None:
               f"p95 {'n/a' if p95 is None else f'{p95 * 1e3:.1f}ms'}")
 
 
+def journal_report() -> None:
+    """Crash-safety status of every live request journal in this
+    process (``inference/serving/journal.py``): directory, segment
+    count/bytes, live (non-terminal) records, compaction recency.
+    Per-process like the engine and router registries: a fresh
+    ``ds_report`` CLI run has no journals; call from inside a serving
+    process (or a test) to see them."""
+    from deepspeed_tpu.inference.serving import live_request_journals
+
+    journals = live_request_journals()
+    if not journals:
+        return  # nothing to report; stay silent like the program table
+    for j in journals:
+        st = j.status()
+        age = st["last_compaction_age_s"]
+        print(f"request journal: {st['dir']} — {st['segments']} "
+              f"segment(s) / {st['bytes']} bytes, "
+              f"{st['non_terminal']} non-terminal of "
+              f"{st['requests_tracked']} tracked, "
+              f"{st['records_appended']} appended "
+              f"({st['records_compacted']} compacted, "
+              f"{st['torn_tails_truncated']} torn tail(s) truncated), "
+              f"last compaction "
+              f"{'never' if age is None else f'{age:.0f}s ago'}"
+              + ("" if st["fsync"] else " [FSYNC OFF — bench probe only]"))
+
+
 def fleet_report() -> None:
     """Fleet status of every live ServingRouter in this process: the
     per-replica health/goodput table plus routed/requeued/incident
@@ -385,6 +412,7 @@ def main(argv=None):
     perf_report()
     speculation_report()
     kv_tier_report()
+    journal_report()
     fleet_report()
     comm_report()
     op_report()

@@ -7,6 +7,9 @@ from .speculative import Drafter, PromptLookupDrafter  # noqa: F401
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      StepWatchdogTimeout, init_serving,
                      live_serving_engines)
+from .journal import (JournalCorruptionError, JournalEntry,  # noqa: F401
+                      JournalLockedError, RequestJournal,
+                      live_request_journals, replay_journal)
 from .replica import Replica  # noqa: F401
 from .router import (FleetMetrics, FleetOutput, FleetRequest,  # noqa: F401
                      RouterConfig, ServingRouter, init_fleet,

@@ -119,6 +119,15 @@ class BlockPool:
         #: monotone counter: evicted pages demoted into the host tier
         #: (chain preserved) instead of destroyed
         self.demotions = 0
+        #: pages that ever SERVED a prefix match (revived off the cached
+        #: LRU or shared by a second owner via :meth:`acquire`, or
+        #: promoted up from the host tier). The demotion admission
+        #: policy keys on this: a page never matched — the single-use
+        #: tail of a finished request — demotes into the host tier's
+        #: PROBATION segment (evicted first) instead of polluting the
+        #: protected LRU, so recovery re-warm churn cannot thrash the
+        #: prefixes the tier exists to keep
+        self._matched: Set[int] = set()
 
     # -- capacity ------------------------------------------------------
 
@@ -237,7 +246,12 @@ class BlockPool:
         if spillable:
             payloads = self.page_reader([bid for bid, _ in spillable])
             for (bid, h), payload in zip(spillable, payloads):
-                if self.host_tier.put(h, payload):
+                # demotion admission policy: pages that never served a
+                # prefix match (single-use tails) go to the PROBATION
+                # segment — the tier evicts those first, so churn can
+                # never thrash the proven-reusable protected entries
+                if self.host_tier.put(h, payload,
+                                      probation=bid not in self._matched):
                     demoted.add(bid)
                     self.demotions += 1
         for bid, h in batch:
@@ -248,6 +262,7 @@ class BlockPool:
                 # stranded entries behind a chain gap)
                 self.host_tier.on_device_drop(h)
             self._free.append(bid)
+            self._matched.discard(bid)  # blanked: the id will be reused
             self.evictions += 1
             if self.tracer is not None and self.tracer.enabled:
                 name = "kv_demote" if bid in demoted else "prefix_evict"
@@ -299,6 +314,7 @@ class BlockPool:
                 self._cached.move_to_end(bid)
             else:
                 self._free.append(bid)
+                self._matched.discard(bid)  # blanked: id will be reused
 
     def acquire(self, block_ids: List[int], owner: str) -> None:
         """Add ``owner`` references to live pages (referenced or cached);
@@ -314,6 +330,9 @@ class BlockPool:
         for bid in block_ids:
             self._cached.pop(bid, None)
             self._refs.setdefault(bid, set()).add(owner)
+            # this page just served a prefix hit (revived or shared):
+            # it has PROVEN reuse value, so a later demotion protects it
+            self._matched.add(bid)
 
     def cow(self, bid: int, owner: str) -> int:
         """Copy-on-write: detach ``owner`` from a SHARED page onto a fresh
@@ -388,8 +407,11 @@ class BlockPool:
             return
         self._hash_to_block[h] = bid
         self._block_hash[bid] = h
-        if self.host_tier is not None:
-            self.host_tier.evict(h)
+        if self.host_tier is not None and self.host_tier.evict(h):
+            # the device copy replaced a host entry: this content WAS
+            # matched (the host hit is what brought it back up), so a
+            # later re-demotion keeps its protected status
+            self._matched.add(bid)
 
     def lookup(self, h: ChainKey) -> Optional[int]:
         """Live page id for a chained hash, or None."""
@@ -575,6 +597,8 @@ class BlockPool:
         self._refs = {mapping[old]: refs for old, refs in self._refs.items()}
         self._cached = OrderedDict((mapping[old], None)
                                    for old in self._cached)
+        self._matched = {mapping[old] for old in self._matched
+                         if old in mapping}
         self._block_hash = {mapping[old]: h
                             for old, h in self._block_hash.items()}
         self._hash_to_block = {h: mapping[old]

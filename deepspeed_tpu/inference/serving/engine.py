@@ -486,6 +486,14 @@ class ServingEngine:
 
         self._requests: Dict[str, Request] = {}
         self._rng = jax.random.PRNGKey(cfg.seed)
+        #: name of this engine's probabilistic DS_FAULT stream (None =
+        #: the process-global stream). The fleet wires each replica to
+        #: its own (``Replica.__init__``) so a p= fault's firing
+        #: sequence is derived per replica from (DS_FAULT_SEED, stream)
+        #: — one replica's probe cadence can never perturb another's,
+        #: and a fuzz schedule replays per-replica regardless of how
+        #: the router interleaves steps
+        self.fault_stream: Optional[str] = None
         self._step_no = 0
         self._draining = False
         #: manual brownout override: None = automatic (occupancy), else forced
@@ -973,7 +981,8 @@ class ServingEngine:
         # chaos-drill point: DS_FAULT=stall:tag=serving_step wedges the
         # worker here; a bounded stall must leave the queue drainable
         fault_injection.maybe_stall("stall", tag="serving_step",
-                                    step=self._step_no)
+                                    step=self._step_no,
+                                    stream=self.fault_stream)
         t0 = time.perf_counter()
 
         # 1. deadline sweep: queued requests past deadline are shed at the
@@ -1108,7 +1117,8 @@ class ServingEngine:
             corrupt = np.zeros((self.config.max_batch_size,), bool)
             spec = fault_injection.maybe_flag("corrupt_logits",
                                               tag="serving_step",
-                                              step=self._step_no)
+                                              step=self._step_no,
+                                              stream=self.fault_stream)
             if spec is not None:
                 # NaN ONE slot's logits (spec may pin slot=N); the guard
                 # must quarantine that request, not the batch. A pin that
@@ -1137,7 +1147,8 @@ class ServingEngine:
                 # chaos point INSIDE the guarded region: a slow/wedged
                 # step is exactly what the watchdog exists for
                 fault_injection.maybe_stall("slow_step", tag="serving_step",
-                                            step=step_no)
+                                            step=step_no,
+                                            stream=self.fault_stream)
                 return self._decode_dispatch(pool, tables, seq_lens,
                                              last_tok, corrupt_j, rng)
 
@@ -1456,7 +1467,8 @@ class ServingEngine:
                 fault_injection.maybe_fail("flaky_prefill",
                                            exc=RuntimeError,
                                            tag="serving_prefill",
-                                           step=self._step_no)
+                                           step=self._step_no,
+                                           stream=self.fault_stream)
             except Exception as e:
                 grants.pop(req.rid, None)
                 self._fail_prefill(req, e)
@@ -1530,7 +1542,8 @@ class ServingEngine:
         if decodes:
             fspec = fault_injection.maybe_flag("corrupt_logits",
                                                tag="serving_step",
-                                               step=self._step_no)
+                                               step=self._step_no,
+                                               stream=self.fault_stream)
             if fspec is not None:
                 decode_slots = {s for s, _, _ in decodes}
                 try:
@@ -1542,7 +1555,8 @@ class ServingEngine:
                 corrupt[pin] = True
         if prefills and fault_injection.maybe_flag(
                 "corrupt_logits", tag="serving_prefill",
-                step=self._step_no) is not None:
+                step=self._step_no,
+                stream=self.fault_stream) is not None:
             corrupt[prefills[0][0]] = True
 
         # packed width: the full capacity, or — with mixed_step_buckets —
@@ -1573,11 +1587,13 @@ class ServingEngine:
             # — a bounded spec must spend its budget on a step that
             # exercises prefill work (same rule as the corrupt probes)
             fault_injection.maybe_stall("slow_step", tag="serving_step",
-                                        step=step_no)
+                                        step=step_no,
+                                        stream=self.fault_stream)
             if has_prefill:
                 fault_injection.maybe_stall("slow_chunk",
                                             tag="serving_prefill",
-                                            step=step_no)
+                                            step=step_no,
+                                            stream=self.fault_stream)
             return self._mixed_dispatch(call_args, W)
 
         tr = self.tracer
@@ -1881,7 +1897,8 @@ class ServingEngine:
         # poisoned KV must never enter either tier's content index
         corrupt = fault_injection.maybe_flag(
             "corrupt_promote", tag="serving_tier",
-            step=self._step_no) is not None
+            step=self._step_no,
+            stream=self.fault_stream) is not None
         payloads = [p for _, _, p in hits]
         if corrupt:
             # payload leaves are host numpy copies by construction
@@ -1983,7 +2000,8 @@ class ServingEngine:
                 # a wedged decode step
                 fault_injection.maybe_stall("slow_promote",
                                             tag="serving_tier",
-                                            step=step_no)
+                                            step=step_no,
+                                            stream=self.fault_stream)
                 return fn(pool, dst, e.arr)
 
             try:
@@ -2219,7 +2237,8 @@ class ServingEngine:
         # chaos point: DS_FAULT=flaky_prefill raises here; step() fails the
         # request and keeps serving
         fault_injection.maybe_fail("flaky_prefill", exc=RuntimeError,
-                                   tag="serving_prefill", step=self._step_no)
+                                   tag="serving_prefill", step=self._step_no,
+                                   stream=self.fault_stream)
         tokens = req.resume_tokens
         L = len(tokens)
         Tb = next_pow2(max(L, self.config.prefill_bucket_min))
@@ -2324,14 +2343,16 @@ class ServingEngine:
         reuses the one compile. The final chunk samples token one (TTFT)
         and activates the slot for decode."""
         fault_injection.maybe_fail("flaky_prefill", exc=RuntimeError,
-                                   tag="serving_prefill", step=self._step_no)
+                                   tag="serving_prefill", step=self._step_no,
+                                   stream=self.fault_stream)
         # chaos point: NaN this chunk's logits as DATA (no recompile) — the
         # guard must quarantine the request BEFORE its pages are
         # content-indexed, or the poison would be served to the next
         # identical prompt
         corrupt = fault_injection.maybe_flag(
             "corrupt_logits", tag="serving_prefill",
-            step=self._step_no) is not None
+            step=self._step_no,
+            stream=self.fault_stream) is not None
         tokens = req.resume_tokens
         start = req.prefill_done
         bs = self.block_pool.block_size
@@ -2370,7 +2391,8 @@ class ServingEngine:
             # chaos point INSIDE the guarded region (the slow_step analog
             # for the mixed step's prefill half)
             fault_injection.maybe_stall("slow_chunk", tag="serving_prefill",
-                                        step=step_no)
+                                        step=step_no,
+                                        stream=self.fault_stream)
             return self._chunked_prefill_fn(*call_args)
 
         # chunked prefill is the mixed step's OTHER device program, so the

@@ -137,6 +137,20 @@ class HostTier(KVTier):
         self.tracer = tracer
         self._lru: "OrderedDict[Any, Any]" = OrderedDict()
         self._nbytes: Dict[Any, int] = {}
+        #: PROBATION segment (segmented LRU): entries demoted from pages
+        #: that never served a prefix match — the single-use tails of
+        #: finished requests. They still hit (and a hit PROMOTES them to
+        #: the protected segment), but capacity evictions take probation
+        #: first, oldest first — so recovery re-warm churn and one-shot
+        #: traffic can never thrash the proven-reusable entries this
+        #: tier exists to keep. Insertion order == probation LRU order
+        #: (a probation entry's only recency event is the promoting hit)
+        self._probation: "OrderedDict[Any, None]" = OrderedDict()
+        #: bytes held by the probation segment, maintained incrementally
+        #: at every insert/promote/drop (the admission pre-check reads
+        #: it per demoted page — summing the segment there would make
+        #: an eviction wave O(|probation|) per page)
+        self._probation_bytes = 0
         #: key -> the SAME key object: the intern table behind
         #: :meth:`canonical` (dicts cannot hand back their stored key)
         self._canon: Dict[Any, Any] = {}
@@ -148,6 +162,11 @@ class HostTier(KVTier):
         self.promotions = 0    # entries consumed by a device-index commit
         self.evictions = 0     # entries dropped for capacity (+ cascades)
         self.rejected = 0      # put() refused (page larger than budget)
+        #: probation demotions refused because admitting them would have
+        #: evicted a PROTECTED entry (tier full of proven-reusable
+        #: pages, no probation entry to pay) — the admission policy's
+        #: own effectiveness counter
+        self.probation_rejected = 0
 
     # -- introspection -------------------------------------------------
 
@@ -188,25 +207,55 @@ class HostTier(KVTier):
                 if not kids:
                     del self._kids[prev]
 
-    def put(self, key, payload) -> bool:
+    def put(self, key, payload, probation: bool = False) -> bool:
         """Demote one page into the tier. Returns False only when the
         page alone exceeds the whole byte budget (the caller then treats
         the eviction as a plain drop and cascades). Re-demoting a key
-        refreshes its recency and payload."""
+        refreshes its recency and payload. ``probation`` files the
+        entry in the evict-first segment (a page that never served a
+        prefix match); a key already protected NEVER demotes back to
+        probation, and a re-put with ``probation=False`` promotes."""
         nb = payload_nbytes(payload)
         if self.max_bytes is not None and nb > self.max_bytes:
             self.rejected += 1
             return False
+        if probation and key not in self._lru and \
+                self._would_overflow(nb):
+            # a probation newcomer never evicts a PROTECTED entry: it
+            # is admitted only when evicting PROBATION entries alone
+            # can make room (both budgets — a large page must fit in
+            # the bytes the probation segment can reclaim, not just
+            # find a probation victim to start on). Otherwise the
+            # single-use page is simply not admitted — this is the
+            # whole demotion-admission policy: churn bounded to the
+            # probation segment, protected entries structurally
+            # un-thrashable by one-shot traffic
+            fits_blocks = not self.max_blocks or \
+                len(self._lru) - len(self._probation) + 1 <= self.max_blocks
+            fits_bytes = self.max_bytes is None or \
+                self.bytes - self._probation_bytes + nb <= self.max_bytes
+            if not (fits_blocks and fits_bytes):
+                self.probation_rejected += 1
+                return False
         if key in self._lru:
-            self.bytes -= self._nbytes[key]
+            old = self._nbytes[key]
+            self.bytes -= old
+            if key in self._probation:
+                self._probation_bytes -= old
+                if not probation:
+                    del self._probation[key]
             self._lru[key] = payload
             self._lru.move_to_end(key)
         else:
             self._lru[key] = payload
             self._canon[key] = key
             self._link(key)
+            if probation:
+                self._probation[key] = None
         self._nbytes[key] = nb
         self.bytes += nb
+        if key in self._probation:
+            self._probation_bytes += nb
         self.demotions += 1
         self._shrink(protect=key)
         return True
@@ -215,10 +264,16 @@ class HostTier(KVTier):
         """Payload for a host-matched key (None when absent), refreshing
         its recency. The payload reference stays valid even if the entry
         is later evicted — promotion captures it here, so an LRU race
-        can never corrupt an in-flight transfer."""
+        can never corrupt an in-flight transfer. A hit on a PROBATION
+        entry promotes it to the protected segment: the match it just
+        served is exactly the reuse evidence probation was waiting
+        for."""
         payload = self._lru.get(key)
         if payload is not None:
             self._lru.move_to_end(key)
+            if key in self._probation:
+                del self._probation[key]
+                self._probation_bytes -= self._nbytes[key]
         return payload
 
     def evict(self, key) -> bool:
@@ -240,8 +295,12 @@ class HostTier(KVTier):
         return True
 
     def _drop_one(self, key, count_eviction: bool) -> None:
-        self.bytes -= self._nbytes.pop(key)
+        nb = self._nbytes.pop(key)
+        self.bytes -= nb
         del self._lru[key]
+        if key in self._probation:
+            del self._probation[key]
+            self._probation_bytes -= nb
         del self._canon[key]
         self._unlink(key)
         if count_eviction:
@@ -277,16 +336,34 @@ class HostTier(KVTier):
     def _shrink(self, protect=None) -> None:
         while self._lru and self._over_budget() and \
                 (len(self._lru) > 1 or next(iter(self._lru)) is not protect):
-            oldest = next(iter(self._lru))
-            if oldest is protect:
-                # never evict the page being inserted; take the next-oldest
-                oldest = next(k for k in self._lru if k is not protect)
+            oldest = self._victim(protect)
+            if oldest is None:
+                return
             self._evict(oldest, count_eviction=True)
+
+    def _victim(self, protect=None):
+        """Capacity-eviction order (segmented LRU): oldest PROBATION
+        entry first — single-use pages pay for churn — then the oldest
+        protected entry; never the page being inserted."""
+        for key in self._probation:
+            if key is not protect:
+                return key
+        for key in self._lru:
+            if key is not protect:
+                return key
+        return None
 
     def _over_budget(self) -> bool:
         if self.max_blocks and len(self._lru) > self.max_blocks:
             return True
         return self.max_bytes is not None and self.bytes > self.max_bytes
+
+    def _would_overflow(self, nb: int) -> bool:
+        """Would admitting one more ``nb``-byte entry push past either
+        budget? (The probation admission pre-check.)"""
+        if self.max_blocks and len(self._lru) + 1 > self.max_blocks:
+            return True
+        return self.max_bytes is not None and self.bytes + nb > self.max_bytes
 
     def clear(self) -> int:
         """Drop EVERY entry — host memory dies with the process, so a
@@ -295,6 +372,8 @@ class HostTier(KVTier):
         pages). Returns the count."""
         n = len(self._lru)
         self._lru.clear()
+        self._probation.clear()
+        self._probation_bytes = 0
         self._nbytes.clear()
         self._canon.clear()
         self._kids.clear()
@@ -313,10 +392,18 @@ class HostTier(KVTier):
         if set(self._lru) != set(self._nbytes) or \
                 set(self._lru) != set(self._canon):
             raise RuntimeError("host tier LRU / byte accounting diverged")
+        if set(self._probation) - set(self._lru):
+            raise RuntimeError("host tier probation entry outside the LRU")
         if self.bytes != sum(self._nbytes.values()):
             raise RuntimeError(
                 f"host tier byte gauge {self.bytes} != "
                 f"{sum(self._nbytes.values())} (sum of entries)")
+        if self._probation_bytes != \
+                sum(self._nbytes[k] for k in self._probation):
+            raise RuntimeError(
+                f"host tier probation byte gauge {self._probation_bytes} "
+                f"!= {sum(self._nbytes[k] for k in self._probation)} "
+                f"(sum of probation entries)")
         for parent, kids in self._kids.items():
             for child in kids:
                 if child not in self._lru:
@@ -338,9 +425,11 @@ class HostTier(KVTier):
             "capacity_blocks": self.max_blocks or None,
             "capacity_bytes": self.max_bytes,
             "blocks": len(self._lru),
+            "probation_blocks": len(self._probation),
             "bytes": self.bytes,
             "demotions": self.demotions,
             "promotions": self.promotions,
             "evictions": self.evictions,
             "rejected": self.rejected,
+            "probation_rejected": self.probation_rejected,
         }

@@ -42,6 +42,11 @@ class Replica:
         self.idx = idx
         self.name = name or f"r{idx}"
         self.engine = engine
+        # independent probabilistic DS_FAULT stream per replica: a p=
+        # fault's firing sequence is derived from (DS_FAULT_SEED, this
+        # name), so a seeded chaos schedule replays PER REPLICA no
+        # matter how the router interleaves steps across the fleet
+        engine.fault_stream = f"replica:{self.name}"
         #: False between :meth:`kill` and :meth:`revive` — a dead process:
         #: never routed to, never stepped
         self.alive = True

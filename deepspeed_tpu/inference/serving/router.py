@@ -60,6 +60,8 @@ transferred prefix and computes only the tail.
 
 import dataclasses
 import itertools
+import os
+import re
 import threading
 import time
 import weakref
@@ -72,6 +74,7 @@ from ...utils import fault_injection
 from ...utils.logging import log_dist
 from .block_pool import ChainKey
 from .engine import ServingEngine
+from .journal import RequestJournal
 from .replica import Replica
 from .scheduler import RejectedError, RequestState, TERMINAL_STATES
 
@@ -142,6 +145,23 @@ class RouterConfig:
     #: whose operator revives inside the bound is unaffected. None
     #: disables the bound (requests wait indefinitely).
     outage_fail_steps: Optional[int] = 50
+    #: crash-safe request journal (``serving/journal.py``): with a
+    #: directory set, every admission is fsync'd BEFORE the fleet door
+    #: accepts, delivery watermarks and terminal verdicts append as the
+    #: request progresses, and :meth:`ServingRouter.recover` replays the
+    #: directory after process death — re-admitting every non-terminal
+    #: request at its delivered-token watermark. None = no journal (the
+    #: pre-PR-15 volatile router).
+    journal_dir: Optional[str] = None
+    #: journal segment rotation size (bytes)
+    journal_segment_bytes: int = 1 << 20
+    #: fsync every journal append (the durability contract). False is
+    #: ONLY for the ds_bench overhead A/B probe
+    journal_fsync: bool = True
+    #: compact the journal every N router steps (sealed segments drop
+    #: terminal-request records; empty ones are deleted). 0 = manual
+    #: ``journal.compact()`` only
+    journal_compact_every: int = 256
 
 
 @dataclasses.dataclass
@@ -152,12 +172,16 @@ class FleetRequest:
 
     prompt: List[int]
     max_new_tokens: int
+    #: REQUIRED — always minted by :meth:`ServingRouter._fresh_fid` (or
+    #: a door-validated client rid). A default factory here would draw
+    #: bare ``fleet-<n>`` ids that bypass the journal-collision skip a
+    #: restarted process needs (its counter restarts at 0 while the
+    #: journal still holds the previous incarnation's fleet-N ids).
+    fid: str
     eos_token_id: Optional[int] = None
     priority: int = 0
     #: absolute ``time.perf_counter()`` stamp; None = no deadline
     deadline: Optional[float] = None
-    fid: str = dataclasses.field(
-        default_factory=lambda: f"fleet-{next(_fid_counter)}")
     state: RequestState = RequestState.QUEUED
     #: tokens DELIVERED to the router so far (a killed replica's
     #: undelivered tokens die with it and are re-generated; a
@@ -172,6 +196,13 @@ class FleetRequest:
     redispatches: int = 0
     #: disaggregation phase: None (normal) | "prefill" | "decode"
     phase: Optional[str] = None
+    #: True when this request was re-admitted by :meth:`recover` after a
+    #: router-process death: its ``submit_time`` is the RECOVERY time
+    #: (the original submit's perf_counter stamp died with the process),
+    #: so TTFT accounting stays honest by carrying the flag instead of a
+    #: fabricated latency — the terminal span and FleetOutput both show
+    #: ``recovered=true``
+    recovered: bool = False
     #: replica whose pool holds this request's committed prefill KV (the
     #: transfer source for the decode-phase dispatch)
     kv_source: Optional[int] = None
@@ -209,6 +240,10 @@ class FleetRequest:
 
 _fid_counter = itertools.count()
 
+#: the auto-generated fid shape — client-supplied rids may not use it
+#: (a collision would make one caller's "duplicate" another's request)
+_RESERVED_FID_RE = re.compile(r"^fleet-\d+$")
+
 
 @dataclasses.dataclass
 class FleetOutput:
@@ -220,6 +255,7 @@ class FleetOutput:
     ttft_s: Optional[float]
     redispatches: int
     served_on: List[int]
+    recovered: bool = False
 
 
 @dataclasses.dataclass
@@ -236,6 +272,14 @@ class FleetMetrics:
     #: stranded requests that re-entered the fleet queue (kill / watchdog
     #: / replica drain / displacement) — each is one survived incident
     requests_requeued: int = 0
+    #: non-terminal requests re-admitted from the journal after a router
+    #: process death — each is one request a crash did NOT lose
+    requests_recovered: int = 0
+    #: duplicate submits suppressed at the door (same rid already known
+    #: to the router or its journal — client retries after a restart)
+    duplicates_suppressed: int = 0
+    #: completed rolling-restart cycles (every replica restarted once)
+    rolling_restarts: int = 0
     #: dispatches routed because of a prefix-affinity match vs. pure
     #: load order (the policy's own effectiveness counters)
     routed_affinity: int = 0
@@ -307,6 +351,15 @@ class ServingRouter:
         #: consecutive ticks of total outage (queue blocked, no live
         #: replica) — drives the outage_fail_steps terminal bound
         self._outage_steps = 0
+        #: crash-safe request journal (None = volatile). Opening it
+        #: replays any existing segments (truncating a torn tail), so a
+        #: restarted router can immediately :meth:`recover`
+        self.journal: Optional[RequestJournal] = None
+        if self.cfg.journal_dir:
+            self.journal = RequestJournal(
+                self.cfg.journal_dir,
+                segment_bytes=self.cfg.journal_segment_bytes,
+                fsync=self.cfg.journal_fsync)
         with _live_routers_lock:
             _LIVE_ROUTERS.add(self)
         log_dist(f"ServingRouter: {len(self.replicas)} replicas, "
@@ -321,10 +374,41 @@ class ServingRouter:
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> str:
+               priority: int = 0, rid: Optional[str] = None) -> str:
         """Enqueue on the FLEET queue; returns the fleet request id.
         Raises :class:`RejectedError` when the router door refuses
-        (fleet queue full / fleet draining)."""
+        (fleet queue full / fleet draining). ``rid`` lets a caller name
+        the request (client-supplied idempotency key): a rid the router
+        already knows — live, terminal, or recovered from the journal —
+        is suppressed at the door and its EXISTING id returned, so a
+        client retrying its submit after a router restart can never
+        double-admit (and never receives the same tokens twice)."""
+        if rid is not None:
+            # ORDER MATTERS: known-rid suppression first — retrying a
+            # router-ISSUED fleet-N fid is the legitimate idempotent
+            # retry (the client got that id from us) and must return
+            # the existing request. Only an UNKNOWN fleet-N rid is a
+            # squat on the auto-fid namespace and is rejected. (Like
+            # poll(), retry-by-rid has no caller authentication — a
+            # caller presenting another's id gets that request; keys
+            # are capability tokens here.)
+            if self._known_rid(rid):
+                self.metrics.duplicates_suppressed += 1
+                if rid not in self._requests:
+                    # journal-known only (retry after a restart before
+                    # recover(), or after forget() released the record):
+                    # materialize it so poll()/forget() can answer for
+                    # the id we are about to hand back — a terminal
+                    # entry becomes a terminal record, a non-terminal
+                    # one re-enters the queue at its watermark
+                    self._materialize_entry(
+                        self.journal.state[rid],
+                        time.time())  # dslint: ignore[determinism] wall clock of record: journaled deadlines are wall-clock so they survive the process
+                return rid
+            if _RESERVED_FID_RE.match(rid):
+                raise ValueError(
+                    f"rid {rid!r} uses the reserved fleet-<n> namespace; "
+                    f"pick a client-side key shape")
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -336,22 +420,9 @@ class ServingRouter:
         # caller — reaching dispatch it would raise out of step() and
         # strand everything else in flight. A request only SOME replicas
         # can hold is admitted; dispatch skips the too-small ones.
-        total = len(prompt) + max_new_tokens
-        if total > max(r.engine.config.max_model_len
-                       for r in self.replicas):
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds every replica's "
-                f"max_model_len (largest: "
-                f"{max(r.engine.config.max_model_len for r in self.replicas)})")
-        if not any(r.engine.block_pool.blocks_for_tokens(total)
-                   <= min(r.engine.nb_max, r.engine.block_pool.num_blocks)
-                   for r in self.replicas):
-            raise ValueError(
-                f"request needs "
-                f"{self.replicas[0].engine.block_pool.blocks_for_tokens(total)} "
-                f"KV blocks at its length cap; no replica's pool serves "
-                f"that many per sequence (raise num_blocks/max_model_len)")
+        err = self._capacity_error(len(prompt), max_new_tokens)
+        if err is not None:
+            raise ValueError(err)
         if self._draining:
             self.metrics.requests_rejected += 1
             raise RejectedError("draining", "fleet is draining; "
@@ -369,12 +440,70 @@ class ServingRouter:
         freq = FleetRequest(prompt=prompt, max_new_tokens=max_new_tokens,
                             eos_token_id=eos_token_id, priority=int(priority),
                             deadline=deadline,
+                            fid=rid if rid is not None else self._fresh_fid(),
                             phase="prefill" if self.cfg.prefill_replicas
                             else None)
+        if self.journal is not None:
+            # write-ahead: the admission is DURABLE (fsync'd) before the
+            # door accepts — a crash from here on recovers this request.
+            # Deadlines are journaled in wall-clock (perf_counter stamps
+            # die with the process)
+            self.journal.append_admit(
+                freq.fid, prompt, max_new_tokens,
+                eos_token_id=eos_token_id, priority=int(priority),
+                deadline_wall=None if deadline_s is None
+                else time.time() + float(deadline_s))  # dslint: ignore[determinism] wall clock of record: the journal's deadline must survive the process
         self.queue.append(freq)
         self._requests[freq.fid] = freq
         self.metrics.requests_submitted += 1
         return freq.fid
+
+    def _capacity_error(self, prompt_len: int,
+                        max_new_tokens: int) -> Optional[str]:
+        """Why NO replica could ever hold a request of this shape (None
+        = at least one can). The fleet door raises on it; recovery fails
+        the request terminal instead — a journaled request from a
+        bigger-configured previous incarnation must not wedge the FIFO
+        queue of a fleet that can never serve it."""
+        total = prompt_len + max_new_tokens
+        if total > max(r.engine.config.max_model_len
+                       for r in self.replicas):
+            return (f"prompt ({prompt_len}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds every replica's "
+                    f"max_model_len (largest: "
+                    f"{max(r.engine.config.max_model_len for r in self.replicas)})")
+        if not any(r.engine.block_pool.blocks_for_tokens(total)
+                   <= min(r.engine.nb_max, r.engine.block_pool.num_blocks)
+                   for r in self.replicas):
+            return (f"request needs "
+                    f"{self.replicas[0].engine.block_pool.blocks_for_tokens(total)} "
+                    f"KV blocks at its length cap; no replica's pool "
+                    f"serves that many per sequence (raise "
+                    f"num_blocks/max_model_len)")
+        return None
+
+    def _known_rid(self, rid: str) -> bool:
+        """Duplicate suppression at the fleet door: the router retains
+        it, or the journal still tracks it. The window is BOUNDED by the
+        journal's terminal-state retention (the newest ~64k terminals;
+        see ``RequestJournal.prune_terminal_state``) and HOLDS across
+        restarts — compaction keeps each terminal's verdict on disk as
+        a tombstone until its entry ages out of that window. A retry
+        older than the window can re-admit."""
+        return rid in self._requests or \
+            (self.journal is not None and self.journal.knows(rid))
+
+    def _fresh_fid(self) -> str:
+        """An auto fid no live record, journal record, or client rid
+        already uses. The counter is process-local, so after a restart
+        it RESTARTS while the journal still holds the previous
+        incarnation's fleet-N ids — without the skip, a new request
+        would silently collide with a recovered one (never journaled,
+        its delivers folding into the dead entry)."""
+        fid = f"fleet-{next(_fid_counter)}"
+        while self._known_rid(fid):
+            fid = f"fleet-{next(_fid_counter)}"
+        return fid
 
     def try_submit(self, prompt_ids, max_new_tokens: int = 16,
                    eos_token_id: Optional[int] = None,
@@ -396,7 +525,8 @@ class ServingRouter:
                            finish_reason=freq.finish_reason,
                            ttft_s=freq.ttft,
                            redispatches=freq.redispatches,
-                           served_on=list(freq.served_on))
+                           served_on=list(freq.served_on),
+                           recovered=freq.recovered)
 
     def cancel(self, fid: str, reason: str = "cancelled") -> bool:
         """Cancel from any live state (False once terminal). A dispatched
@@ -454,6 +584,128 @@ class ServingRouter:
     def resume_admission(self) -> None:
         self._draining = False
 
+    # -- crash recovery (the journal's read side) ----------------------
+
+    def recover(self, journal_dir: Optional[str] = None) -> List[str]:
+        """Replay the request journal after router-process death and
+        re-admit every non-terminal request at its delivered-token
+        watermark (``prompt + delivered`` is the resume stream — the
+        recompute-resume semantics replica kills already proved, lifted
+        to process death; greedy traffic is token-identical to an
+        undisturbed run). Terminal journal entries are materialized as
+        terminal fleet records so ``poll`` answers for them and a client
+        retry of a finished rid is suppressed at the door instead of
+        re-served. Returns the re-admitted fids, in admit order.
+
+        Recovered requests carry ``recovered=True`` (FleetOutput, the
+        replica-side terminal span) and their ``submit_time`` is the
+        RECOVERY time — the honest TTFT stance: the original submit's
+        monotonic stamp died with the old process, and a fabricated
+        cross-process latency would poison the percentiles. Deadlines DO
+        survive (journaled in wall-clock): a request whose budget
+        expired during the outage times out here, it does not rise from
+        the dead."""
+        if journal_dir is not None:
+            if self.journal is None:
+                self.journal = RequestJournal(
+                    journal_dir,
+                    segment_bytes=self.cfg.journal_segment_bytes,
+                    fsync=self.cfg.journal_fsync)
+            elif os.path.abspath(self.journal.dir) != \
+                    os.path.abspath(journal_dir):
+                raise ValueError(
+                    f"recover({journal_dir!r}): this router already "
+                    f"journals to {self.journal.dir!r}")
+        if self.journal is None:
+            raise ValueError("recover() needs a journal: set "
+                             "RouterConfig.journal_dir or pass "
+                             "journal_dir")
+        now_wall = time.time()  # dslint: ignore[determinism] wall clock of record: journaled deadlines are wall-clock so they survive the process
+        recovered: List[str] = []
+        for ent in list(self.journal.state.values()):
+            if self._materialize_entry(ent, now_wall):
+                recovered.append(ent.fid)
+        self.journal.compact()
+        if recovered:
+            log_dist(f"fleet: recovered {len(recovered)} non-terminal "
+                     f"request(s) from {self.journal.dir} "
+                     f"(delivered-token watermarks carried)", ranks=[0])
+        return recovered
+
+    def _materialize_entry(self, ent, now_wall: float) -> bool:
+        """Materialize ONE journal entry into the router's request table
+        (idempotent — an fid already held is left alone): terminal
+        entries become terminal fleet records (``poll`` answers, retries
+        suppress, nothing transitions), non-terminal ones re-enter the
+        fleet queue at their delivered-token watermark — or go terminal
+        right here when the journaled wall-clock deadline expired during
+        the outage, every token was already delivered, or no replica of
+        THIS fleet can hold them. Returns True only for a re-queued
+        (live-recovered) entry. Shared by :meth:`recover` and the door's
+        duplicate suppression (a journal-known rid must be answerable by
+        ``poll`` the moment ``submit`` returns it)."""
+        if ent.fid in self._requests:
+            return False
+        if ent.done:
+            # materialized, not transitioned: the terminal happened
+            # in the previous incarnation and is already journaled —
+            # this just lets poll()/retries answer for it
+            try:
+                state = RequestState(ent.state)
+                reason = ent.reason
+            except ValueError:
+                # a NEWER writer's terminal vocabulary (journal._fold
+                # keeps unknown states verbatim for exactly this
+                # rollback case) — degrade to FAILED with the foreign
+                # verdict in the reason instead of aborting recovery
+                # and losing every remaining non-terminal request
+                state = RequestState.FAILED
+                reason = f"journal-state:{ent.state}"
+            self._requests[ent.fid] = FleetRequest(
+                prompt=list(ent.prompt),
+                max_new_tokens=ent.max_new_tokens,
+                eos_token_id=ent.eos_token_id, priority=ent.priority,
+                fid=ent.fid, state=state,
+                tokens=list(ent.tokens),
+                finish_reason=reason, recovered=True)
+            return False
+        remaining = None if ent.deadline_wall is None \
+            else ent.deadline_wall - now_wall
+        freq = FleetRequest(
+            prompt=list(ent.prompt),
+            max_new_tokens=ent.max_new_tokens,
+            eos_token_id=ent.eos_token_id, priority=ent.priority,
+            fid=ent.fid, tokens=list(ent.tokens),
+            deadline=None if remaining is None
+            else time.perf_counter() + remaining,
+            phase="prefill" if self.cfg.prefill_replicas else None,
+            recovered=True)
+        self._requests[ent.fid] = freq
+        if remaining is not None and remaining <= 0:
+            # the deadline expired during the outage
+            self._fleet_release(freq, RequestState.TIMEOUT, "deadline")
+            return False
+        hit_eos = ent.eos_token_id is not None and ent.tokens and \
+            ent.tokens[-1] == ent.eos_token_id
+        if freq.remaining_new <= 0 or hit_eos:
+            # every token was delivered; only the terminal record
+            # was lost to the crash — finish, deliver nothing twice
+            self._fleet_release(freq, RequestState.FINISHED,
+                                "eos" if hit_eos else "length")
+            return False
+        if self._capacity_error(len(freq.prompt),
+                                freq.max_new_tokens) is not None:
+            # journaled by a bigger-configured incarnation: THIS
+            # fleet can never hold it — fail terminal instead of
+            # wedging the FIFO queue head forever (submit raises
+            # the same condition back at the caller)
+            self._fleet_release(freq, RequestState.FAILED,
+                                "capacity")
+            return False
+        self.queue.append(freq)
+        self.metrics.requests_recovered += 1
+        return True
+
     # -- replica lifecycle ---------------------------------------------
 
     def kill_replica(self, idx: int, reason: str = "replica_kill") -> int:
@@ -495,6 +747,61 @@ class ServingRouter:
     def undrain_replica(self, idx: int) -> None:
         self.replicas[idx].end_drain()
 
+    def rolling_restart(self, capacity_floor: Optional[int] = None,
+                        max_steps_per_replica: int = 2000
+                        ) -> Dict[str, Any]:
+        """Deploy-time drill: restart EVERY replica, one at a time —
+        ``drain_replica`` (its queued work re-enters the fleet, its
+        residents run dry while the rest absorb) → kill (cold restart:
+        pages return, both cache tiers drop) → revive — so the fleet
+        never serves below ``capacity_floor`` live replicas (default
+        N-1: exactly one down at any moment). Requests never notice
+        beyond latency: shed work re-serves elsewhere with delivered
+        tokens carried, the recompute-resume invariant end to end.
+
+        Raises RuntimeError when a replica cannot drain (or the floor
+        cannot be met) within ``max_steps_per_replica`` fleet ticks —
+        a stuck rolling restart must fail loudly, not spin."""
+        n = len(self.replicas)
+        floor = n - 1 if capacity_floor is None else int(capacity_floor)
+        if not 0 <= floor <= n - 1:
+            raise ValueError(
+                f"capacity_floor must be in [0, {n - 1}] (one replica "
+                f"must be restartable), got {floor}")
+        restarted: List[str] = []
+        shed_total = 0
+        for rep in self.replicas:
+            steps = 0
+            # the capacity floor gates the takedown, not the drain: wait
+            # out delayed auto-revives before touching the next replica
+            while sum(r.alive for r in self.replicas) \
+                    - (1 if rep.alive else 0) < floor:
+                self.step()
+                steps += 1
+                if steps > max_steps_per_replica:
+                    raise RuntimeError(
+                        f"rolling restart: capacity floor {floor} "
+                        f"unreachable before restarting {rep.name}")
+            if rep.alive:
+                shed_total += self.drain_replica(rep.idx)
+                steps = 0
+                while rep.engine.has_work():
+                    self.step()
+                    steps += 1
+                    if steps > max_steps_per_replica:
+                        raise RuntimeError(
+                            f"rolling restart: replica {rep.name} never "
+                            f"ran dry ({max_steps_per_replica} ticks)")
+                self.kill_replica(rep.idx, reason="rolling_restart")
+            self.revive_replica(rep.idx)
+            restarted.append(rep.name)
+        self.metrics.rolling_restarts += 1
+        log_dist(f"fleet: rolling restart complete "
+                 f"({len(restarted)} replicas, {shed_total} shed, "
+                 f"floor {floor})", ranks=[0])
+        return {"restarted": restarted, "shed": shed_total,
+                "capacity_floor": floor}
+
     # ------------------------------------------------------------------
     # one router tick
     # ------------------------------------------------------------------
@@ -514,6 +821,12 @@ class ServingRouter:
         self._collect()
         self._check_total_outage()
         self._step_no += 1
+        if self.journal is not None and self.cfg.journal_compact_every \
+                and self._step_no % self.cfg.journal_compact_every == 0:
+            # steady-state hygiene: sealed segments shed their terminal
+            # records so the journal tracks the LIVE set, not traffic
+            self.journal.compact()
+            self.journal.prune_terminal_state()
         m = self.metrics
         m.steps += 1
         m.queue_depth = len(self.queue)
@@ -547,7 +860,15 @@ class ServingRouter:
         """``DS_FAULT=replica_kill[:replica=N][:step=K]`` kills one
         replica mid-traffic (the storm drill). A malformed or dead pin
         falls back to the first live replica — an injection point must
-        never crash the loop it is drilling."""
+        never crash the loop it is drilling.
+
+        ``DS_FAULT=router_crash:tag=serving_fleet[:step=K]`` kills THE
+        ROUTER PROCESS itself (``os._exit`` — models kill -9 / OOM, no
+        flush beyond what the journal already fsync'd): the crash drill
+        behind ``ServingRouter.recover`` — the bench and the chaos
+        fuzzer arm it in a subprocess and recover in the parent."""
+        fault_injection.maybe_crash("router_crash", tag="serving_fleet",
+                                    step=self._step_no)
         spec = fault_injection.maybe_flag("replica_kill",
                                           tag="serving_fleet",
                                           step=self._step_no)
@@ -709,6 +1030,11 @@ class ServingRouter:
                 # the handoff lands BETWEEN submit and the replica's next
                 # step — admission matches the transferred prefix there
                 self._handoff_kv(freq, rep)
+            if freq.recovered:
+                # the replica-side terminal span carries recovered=true,
+                # so trace_view's TTFT/SLO breakdowns can separate
+                # crash-replayed traffic from organic arrivals
+                rep.engine.request(rid).recovered = True
             freq.replica, freq.rid = rep.idx, rid
             freq.served_on.append(rep.idx)
             freq.state = RequestState.RUNNING
@@ -791,18 +1117,33 @@ class ServingRouter:
                 if out.finish_reason != "replica_kill":
                     self._deliver(freq, out)
                 self._requeue(freq, out.finish_reason or req.state.value)
+        if self.journal is not None:
+            # land any batched watermark whose terminal has not followed
+            # (requeued strandings) before the caller can observe tokens
+            self.journal.flush()
 
     def _deliver(self, freq: FleetRequest, out) -> None:
         """Fold one replica segment's output into the fleet record. The
         fleet TTFT anchors on the REPLICA's measured first-token time
         (dispatch + its ttft), not on collection time — collection
         happens at segment end, which would inflate TTFT to total
-        generation latency."""
+        generation latency. With the journal armed the delivery
+        watermark (token ids included) is made durable BEFORE the
+        caller can observe the tokens: a recovery resumes at exactly
+        this watermark, so no token is ever delivered twice."""
         if out.tokens and freq.first_token_time is None:
             if out.ttft_s is not None and freq.dispatch_time is not None:
                 freq.first_token_time = freq.dispatch_time + out.ttft_s
             else:
                 freq.first_token_time = time.perf_counter()
+        if self.journal is not None and out.tokens:
+            # batched fsync: most delivers are immediately followed by
+            # the terminal append (one fsync covers both); stranded-
+            # segment delivers are flushed at the end of _collect —
+            # either way the record is on disk before step()/cancel()
+            # returns control to a caller that could observe the tokens
+            self.journal.append_deliver(freq.fid, list(out.tokens),
+                                        sync=False)
         freq.tokens.extend(out.tokens)
 
     def _on_finished(self, freq: FleetRequest, out, rep: Replica) -> None:
@@ -851,6 +1192,10 @@ class ServingRouter:
         freq.state = state
         freq.finish_reason = reason
         freq.finish_time = time.perf_counter()
+        if self.journal is not None:
+            # the verdict is durable before the caller can observe it:
+            # recovery will never re-serve (or re-deliver) this request
+            self.journal.append_terminal(freq.fid, state.value, reason)
         field = {RequestState.FINISHED: "requests_finished",
                  RequestState.FAILED: "requests_failed",
                  RequestState.TIMEOUT: "requests_timeout",
@@ -878,6 +1223,8 @@ class ServingRouter:
                                   for i, n in
                                   sorted(snapshot_items(
                                       self.routed_by_replica))},
+            "journal": None if self.journal is None
+            else self.journal.status(),
             "counters": self.metrics.snapshot(),
         }
 

@@ -135,6 +135,11 @@ class Request:
     spec_drafted: float = 0.0
     spec_accepted: float = 0.0
     preemptions: int = 0
+    #: stamped by the fleet router when this segment serves a request
+    #: re-admitted from the crash journal: the terminal span carries
+    #: ``recovered=true`` so TTFT/SLO accounting can tell crash-replay
+    #: traffic from organic arrivals
+    recovered: bool = False
     admit_order: int = -1     # monotone stamp set at admission (victim pick)
     #: latest admission stamp (perf_counter seconds; None while queued)
     admit_time: Optional[float] = None
@@ -570,6 +575,8 @@ class Scheduler:
                     else round(req.ttft, 6)}
             if req.slo_verdict is not None:
                 args["slo"] = req.slo_verdict
+            if req.recovered:
+                args["recovered"] = True
             self.tracer.complete("request", req.submit_time,
                                  req.finish_time, cat="request", args=args)
 

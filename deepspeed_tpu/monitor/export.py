@@ -587,11 +587,21 @@ def fleet_statusz(router) -> str:
                      f"{row['goodput_tokens_per_sec']:>9.1f}"
                      f"{row['prefix_index_blocks']:>11}{verd:>24}")
     lines.append("")
+    j = st.get("journal")
+    if j is not None:
+        age = j["last_compaction_age_s"]
+        lines.append(f"journal: {j['dir']} — {j['segments']} segment(s) "
+                     f"/ {j['bytes']} bytes, {j['non_terminal']} "
+                     f"non-terminal of {j['requests_tracked']} tracked, "
+                     f"last compaction "
+                     f"{'never' if age is None else f'{age:.0f}s ago'}")
     c = st["counters"]
     lines.append(f"routed: {int(c['routed_affinity'])} by prefix affinity, "
                  f"{int(c['routed_load'])} by load; "
                  f"requeued {int(c['requests_requeued'])}, "
-                 f"rejected {int(c['requests_rejected'])}")
+                 f"rejected {int(c['requests_rejected'])}"
+                 + (f", recovered {int(c['requests_recovered'])}"
+                    if c.get("requests_recovered") else ""))
     lines.append(f"incidents: {int(c['replica_kills'])} kills, "
                  f"{int(c['replica_revives'])} revives, "
                  f"{int(c['ejections'])} ejections, "

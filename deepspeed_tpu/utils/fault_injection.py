@@ -47,15 +47,27 @@ Serving chaos vocabulary (injection points in ``serving/engine.py``)::
                                              # request BEFORE the page is
                                              # content-re-indexed; the
                                              # clean host copy survives
+    DS_FAULT=router_crash:step=8:tag=serving_fleet
+                                             # kill the ROUTER PROCESS at
+                                             # fleet step 8 (os._exit —
+                                             # kill -9 semantics; only the
+                                             # request journal's fsync'd
+                                             # bytes survive, and
+                                             # ServingRouter.recover
+                                             # replays them)
 
 Recognized match keys: ``step`` / ``rank`` / ``tag`` (spec fires only when
 the injection point reports a matching value), ``fails`` (bounded faults:
 fire at most N times, then the point behaves normally), ``seconds`` (stall
 duration; default forever), ``p`` (probabilistic faults: fire with
 probability p per otherwise-matching probe, seeded by ``DS_FAULT_SEED`` so
-chaos runs replay), ``phase`` (``crash_during_save``: ``begin`` dies
-before any bytes are written, default ``commit`` dies between the data
-commit and the manifest write — the classic partial save).
+chaos runs replay — injection points may also declare a named ``stream``,
+and each stream draws from its own (seed, stream)-derived generator: the
+serving fleet wires one per replica, so a fuzz schedule replays
+per-replica regardless of step interleaving), ``phase``
+(``crash_during_save``: ``begin`` dies before any bytes are written,
+default ``commit`` dies between the data commit and the manifest write —
+the classic partial save).
 
 Injection points live in the checkpoint save path, the engine step loop,
 the serving engine's admit/prefill/decode path, and ``init_distributed``;
@@ -85,7 +97,8 @@ class FaultSpec:
 
     def matches(self, *, step: Optional[int] = None, rank: Optional[int] = None,
                 tag: Optional[str] = None,
-                phase: Optional[str] = None) -> bool:
+                phase: Optional[str] = None,
+                stream: Optional[str] = None) -> bool:
         if "step" in self.params and (step is None
                                       or int(self.params["step"]) != int(step)):
             return False
@@ -102,7 +115,7 @@ class FaultSpec:
         if fails is not None and self.fired >= int(fails):
             return False
         p = self.params.get("p")
-        if p is not None and _prob_rng().random() >= float(p):
+        if p is not None and _prob_rng(stream).random() >= float(p):
             return False
         return True
 
@@ -133,16 +146,29 @@ def parse_faults(text: str) -> List[FaultSpec]:
 # DS_FAULT get a fresh parse.
 _cache: Tuple[Optional[str], List[FaultSpec]] = (None, [])
 
-# Probabilistic faults (p=<prob>) draw from one seeded stream so a chaos
+# Probabilistic faults (p=<prob>) draw from seeded streams so a chaos
 # drill replays exactly under the same DS_FAULT_SEED; reset() reseeds.
-_prob: Optional[random.Random] = None
+# Streams are PER-NAME: an injection point that declares a stream (the
+# fleet wires each replica's engine to its own — ``replica:r0``,
+# ``replica:r1``, ...) draws from a generator derived from (seed, stream),
+# so one replica's probe cadence can never perturb another's firing
+# sequence — a fuzz schedule replays per-replica regardless of how the
+# router interleaves their steps. Points that declare no stream share
+# the process-global stream (seed alone), the pre-fleet behavior.
+_prob_streams: Dict[Optional[str], random.Random] = {}
 
 
-def _prob_rng() -> random.Random:
-    global _prob
-    if _prob is None:
-        _prob = random.Random(int(os.environ.get("DS_FAULT_SEED", "0")))
-    return _prob
+def _prob_rng(stream: Optional[str] = None) -> random.Random:
+    rng = _prob_streams.get(stream)
+    if rng is None:
+        seed = int(os.environ.get("DS_FAULT_SEED", "0"))
+        # derive per-stream: a string seed folds the stream name into
+        # the generator state deterministically (random.Random hashes
+        # str seeds via SHA-512, stable across processes)
+        rng = random.Random(seed if stream is None
+                            else f"{seed}/{stream}")
+        _prob_streams[stream] = rng
+    return rng
 
 
 def _specs() -> List[FaultSpec]:
@@ -157,22 +183,23 @@ def _specs() -> List[FaultSpec]:
 
 def get_fault(name: str, *, step: Optional[int] = None,
               rank: Optional[int] = None, tag: Optional[str] = None,
-              phase: Optional[str] = None) -> Optional[FaultSpec]:
+              phase: Optional[str] = None,
+              stream: Optional[str] = None) -> Optional[FaultSpec]:
     for spec in _specs():
         if spec.name == name and spec.matches(step=step, rank=rank, tag=tag,
-                                              phase=phase):
+                                              phase=phase, stream=stream):
             return spec
     return None
 
 
 def reset() -> None:
-    """Forget trigger counts and reseed the probabilistic stream (test
-    isolation). Listeners survive a reset on purpose: a flight recorder
-    armed for the whole chaos drill must keep observing across the
-    per-test DS_FAULT re-arms."""
-    global _cache, _prob
+    """Forget trigger counts and reseed every probabilistic stream (test
+    isolation / episode replay). Listeners survive a reset on purpose: a
+    flight recorder armed for the whole chaos drill must keep observing
+    across the per-test DS_FAULT re-arms."""
+    global _cache
     _cache = (None, [])
-    _prob = None
+    _prob_streams.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -122,6 +122,18 @@ RULES: Dict[str, Dict[str, str]] = {
                 "re-raise to a caller that funnels through _release)",
         "counterpart": "BlockPool check_consistent leak detection",
     },
+    "journal-write": {
+        "family": "terminal-path",
+        "what": "request-journal append (append_admit / append_deliver / "
+                "append_terminal) outside the router's write-ahead seam "
+                "(submit / _deliver / _fleet_release)",
+        "hint": "journal appends carry the WAL ordering contract (admit "
+                "fsync'd BEFORE the door accepts, watermark BEFORE the "
+                "caller observes, verdict at the one terminal funnel) — "
+                "route the write through the allowlisted router method "
+                "instead of appending ad hoc",
+        "counterpart": "crash-recovery duplicate delivery / lost request",
+    },
     "determinism": {
         "family": "determinism",
         "what": "time.time / random.* / np.random.* in serving, monitor "

@@ -48,6 +48,16 @@ _TERMINAL_ALLOW_FUNCS = {"_release", "_fleet_release"}
 
 _ACQUIRE_METHODS = {"allocate", "acquire", "cow"}
 
+#: journal append verbs and the ONLY router methods allowed to call them
+#: (``journal.py`` itself owns its internals and is exempt): the WAL
+#: ordering — admit before the door accepts, watermark before the caller
+#: observes tokens, verdict at the terminal funnel — lives in exactly
+#: these seams, so an append anywhere else is a finding even when it
+#: "works": it silently changes what a crash can lose
+_JOURNAL_APPEND_METHODS = {"append_admit", "append_deliver",
+                           "append_terminal"}
+_JOURNAL_ALLOW_FUNCS = {"submit", "_deliver", "_fleet_release"}
+
 
 def _dotted(node: ast.AST) -> str:
     try:
@@ -72,6 +82,7 @@ def check(ctx: FileCtx) -> List[Finding]:
         out.extend(_check_terminal(ctx))
         out.extend(_check_release_calls(ctx))
         out.extend(_check_acquire_release(ctx))
+        out.extend(_check_journal_writes(ctx))
     out.extend(_check_determinism(ctx))
     return out
 
@@ -196,6 +207,30 @@ def _check_release_calls(ctx: FileCtx) -> List[Finding]:
             f"requeue/cancel paths must use the scheduler's "
             f"cancel/fail/timeout API (or ServingRouter._fleet_release "
             f"for fleet-level terminals)"))
+    return out
+
+
+def _check_journal_writes(ctx: FileCtx) -> List[Finding]:
+    """The journal's write-ahead seam: appends only from the router
+    methods that carry the ordering contract. ``journal.py`` itself is
+    exempt (recovery/compaction are its internals)."""
+    if ctx.norm_path.endswith("inference/serving/journal.py"):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _JOURNAL_APPEND_METHODS):
+            continue
+        fname = _enclosing_func_name(ctx, node)
+        if fname in _JOURNAL_ALLOW_FUNCS:
+            continue
+        out.append(ctx.finding(
+            node, "journal-write",
+            f"journal {node.func.attr}() in {fname or 'module'} — "
+            f"appends must ride the router's write-ahead seam "
+            f"({'/'.join(sorted(_JOURNAL_ALLOW_FUNCS))}) so the "
+            f"crash-recovery ordering contract holds"))
     return out
 
 

@@ -189,6 +189,52 @@ def test_queue_survives_storm_behind_deadlines(srv, chaos):
         all(srv.poll(r).state == "finished" for r in rids)
 
 
+def test_fault_streams_replay_per_replica(monkeypatch):
+    """DS_FAULT_SEED stream independence across fleet replicas: each
+    replica's probabilistic fault stream is derived from (seed, replica
+    stream name), so replaying one episode twice — with DIFFERENT probe
+    interleavings — fires the identical per-replica sequence. Before
+    the fix every replica drew from ONE shared stream and the firing
+    pattern depended on step interleaving, so a fuzz schedule was not
+    replayable per-replica."""
+    monkeypatch.setenv(fault_injection.ENV_VAR,
+                       "slow_step:p=0.5:seconds=0:tag=serving_step")
+    monkeypatch.setenv("DS_FAULT_SEED", "13")
+    streams = ("replica:r0", "replica:r1")
+
+    def probe(stream):
+        return fault_injection.get_fault(
+            "slow_step", tag="serving_step", stream=stream) is not None
+
+    fault_injection.reset()
+    sequential = {s: [probe(s) for _ in range(12)] for s in streams}
+    fault_injection.reset()
+    interleaved = {s: [] for s in streams}
+    for i in range(12):
+        # a different interleaving (and extra unrelated draws on the
+        # OTHER stream) must not perturb either replica's sequence
+        for s in (streams if i % 2 else reversed(streams)):
+            interleaved[s].append(probe(s))
+    fault_injection.reset()
+    assert sequential == interleaved
+    # and the streams are genuinely independent, not one shared RNG
+    assert sequential[streams[0]] != sequential[streams[1]]
+    # the fleet wiring: each replica stamps its engine with its own
+    # stream name (the one the engine's probe sites pass through)
+    from deepspeed_tpu.inference.serving.replica import Replica
+
+    class _Eng:  # Replica.__init__ probe surface, nothing more
+        metrics = type("M", (), {"steps": 0})()
+        fault_stream = None
+
+        def has_work(self):
+            return False
+
+    eng = _Eng()
+    Replica(1, eng)
+    assert eng.fault_stream == "replica:r1"
+
+
 def test_chaos_never_recompiled(srv):
     """Runs last in the module: every drill above rode the SAME compiled
     program — faults are data/runtime toggles, not new shapes — and the

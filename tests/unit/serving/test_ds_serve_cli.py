@@ -239,3 +239,39 @@ def test_replicas_demo_serves_fleet_and_reports(tmp_path):
     assert len(results) == 6
     assert all(rec["state"] == "finished" for rec in results)
     assert all(rec["served_on"] for rec in results)
+
+
+def test_journal_dir_demo_durable_and_restart_recovers_nothing(tmp_path):
+    """--journal-dir serves through a journaled 1-replica fleet: the
+    final report carries the journal block, records show recovered
+    status, and a SECOND run on the same directory recovers nothing
+    (everything terminal on disk) while still serving fresh traffic —
+    the restart path end to end."""
+    jdir = str(tmp_path / "journal")
+
+    def run(n):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_serve"),
+             "--demo", str(n), "--cpu", "--journal-dir", jdir],
+            capture_output=True, text=True, timeout=240, cwd=REPO)
+        assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+        recs = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.strip().startswith("{")]
+        return recs, r.stderr
+
+    recs, err = run(3)
+    final = recs[-1]
+    j = final["fleet"]["journal"]
+    assert j["dir"] == jdir and j["fsync"] is True
+    assert j["non_terminal"] == 0          # everything landed terminal
+    results = [rec for rec in recs[:-1] if "rid" in rec]
+    assert len(results) == 3
+    assert all(rec["state"] == "finished" and not rec["recovered"]
+               for rec in results)
+
+    recs2, err2 = run(2)
+    assert "recovered" not in err2          # nothing live to recover
+    final2 = recs2[-1]
+    # the journal replayed the previous incarnation's records
+    assert final2["fleet"]["journal"]["requests_tracked"] >= 3
+    assert final2["fleet"]["counters"]["requests_recovered"] == 0

@@ -67,6 +67,107 @@ def test_host_tier_lru_block_and_byte_budgets():
         HostTier()                 # a tier needs SOME capacity
 
 
+def test_host_tier_probation_segment_policy():
+    """Demotion admission policy (segmented LRU): probation entries pay
+    for capacity evictions first, a hit promotes them to protected, and
+    a probation newcomer NEVER evicts a protected entry — one-shot
+    churn is structurally unable to thrash the proven-reusable set."""
+    t = HostTier(max_blocks=2)
+    prot, p1, p2 = _Key("prot"), _Key("p1"), _Key("p2")
+    t.put(prot, _pl())                       # protected (matched page)
+    t.put(p1, _pl(), probation=True)
+    # over budget: the probation entry pays, NOT the older protected one
+    t.put(p2, _pl(), probation=True)
+    assert t.contains(prot) and t.contains(p2) and not t.contains(p1)
+    assert t.stats()["probation_blocks"] == 1
+    # a hit is the reuse evidence probation waits for: p2 promotes
+    assert t.get(p2) is not None
+    assert t.stats()["probation_blocks"] == 0
+    # tier now FULL of protected entries: single-use churn is refused at
+    # the door instead of evicting anything protected
+    churn = [_Key(f"c{i}") for i in range(4)]
+    for k in churn:
+        assert not t.put(k, _pl(), probation=True)
+    assert t.probation_rejected == 4
+    assert t.contains(prot) and t.contains(p2)
+    # a protected (matched) demotion still admits normally — plain LRU
+    t.put(_Key("prot2"), _pl())
+    assert not t.contains(prot) and t.contains(p2)  # LRU order: get(p2)
+    t.check()
+    # re-demote of a PROTECTED key never degrades it back to probation
+    t2 = HostTier(max_blocks=4)
+    k = _Key("k")
+    t2.put(k, _pl())
+    t2.put(k, _pl(), probation=True)
+    assert t2.stats()["probation_blocks"] == 0
+    # BYTE budget: a large probation page is refused when evicting the
+    # whole probation segment still could not make room — it must never
+    # get in by evicting protected bytes
+    unit = payload_nbytes(_pl())
+    t3 = HostTier(max_bytes=3 * unit)
+    pa, pb, q1 = _Key("pa"), _Key("pb"), _Key("q1")
+    t3.put(pa, _pl())
+    t3.put(pb, _pl())
+    t3.put(q1, _pl(), probation=True)              # 1 unit reclaimable
+    assert not t3.put(_Key("big"), _pl(16), probation=True)
+    assert t3.probation_rejected == 1
+    assert t3.contains(pa) and t3.contains(pb)
+    assert len(t3) == 3                            # nothing evicted
+    # while a SAME-SIZE probation newcomer still churns probation only
+    assert t3.put(_Key("q2"), _pl(), probation=True)
+    assert not t3.contains(q1)                     # q1 paid, not pa/pb
+    assert t3.contains(pa) and t3.contains(pb)
+    assert t3.stats()["probation_blocks"] == 1
+    t3.check()
+
+
+def test_pool_demotion_routes_unmatched_pages_to_probation():
+    """The pool side of the policy: pages that never served a prefix
+    match (single-use tails) demote as probation; pages revived/shared
+    via acquire — and pages whose host copy a commit consumed — demote
+    protected."""
+    pool = BlockPool(6, 4)
+    tier = HostTier(max_blocks=3)
+    pool.attach_host_tier(tier, lambda bids: [_pl() for _ in bids])
+    # a MATCHED chain: commit, free, re-match + acquire (the hit), free
+    tok_a = list(range(1, 5))
+    ha = pool.prefix_block_hashes(tok_a)
+    [ba] = pool.allocate(1, "w")
+    pool.commit_hash(ba, ha[0])
+    pool.free([ba], "w")
+    m = pool.match_prefix(tok_a + [9], ha)
+    assert m == [ba]
+    pool.acquire(m, "r2")
+    pool.free(m, "r2")
+    # three single-use chains: committed, freed, never matched
+    for i in range(3):
+        tok = [100 + 4 * i + j for j in range(4)]
+        [b] = pool.allocate(1, f"s{i}")
+        pool.commit_hash(b, pool.prefix_block_hashes(tok)[0])
+        pool.free([b], f"s{i}")
+    # churn the whole device LRU off: the eviction wave demotes —
+    # matched page protected, single-use pages probation (cap 3: the
+    # oldest probation page pays, the protected one survives)
+    bb = pool.allocate(6, "churn")
+    assert tier.contains(ha[0])
+    assert len(tier) == 3
+    assert tier.stats()["probation_blocks"] == 2
+    pool.free(bb, "churn")
+    pool.check_consistent()
+    # round trip: a host hit consumed by a device commit re-demotes as
+    # PROTECTED (the hit proved reuse), even though the new device page
+    # was allocated, not acquired
+    [nb] = pool.allocate(1, "c")
+    assert tier.get(ha[0]) is not None   # the admission-path capture
+    pool.commit_hash(nb, ha[0])          # consumes the host entry
+    assert not tier.contains(ha[0])
+    pool.free([nb], "c")
+    pool.allocate(6, "churn2")           # demote everything again
+    assert tier.contains(ha[0])
+    assert ha[0] not in tier._probation
+    pool.check_consistent()
+
+
 def test_host_tier_capacity_eviction_cascades_orphaned_chain():
     """Evicting a chain's head for capacity drops host children the gap
     orphans (they could never be matched again) — unless the parent is

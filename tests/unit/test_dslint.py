@@ -431,6 +431,47 @@ def test_release_call_allowed_in_scheduler_and_fleet_release(tmp_path):
     assert not rules_at(report, "terminal-write")
 
 
+def test_journal_write_outside_wal_seam_flagged(tmp_path):
+    """Journal appends carry the write-ahead ordering contract — an
+    append from anywhere but the router's submit/_deliver/_fleet_release
+    seam is a finding, even when it 'works'."""
+    src = """
+    class ServingRouter:
+        def submit(self, prompt):
+            self.journal.append_admit("f1", prompt, 8)
+
+        def _deliver(self, freq, out):
+            self.journal.append_deliver(freq.fid, out.tokens)
+
+        def _fleet_release(self, freq, state, reason):
+            self.journal.append_terminal(freq.fid, state, reason)
+
+        def _collect(self):
+            self.journal.append_terminal("f1", "finished", "length")
+    """
+    report = lint_src(tmp_path, src, name="router.py",
+                      subdir="inference/serving")
+    hits = rules_at(report, "journal-write")
+    assert len(hits) == 1  # the three seam methods stay quiet
+    assert hits[0].line == line_of(src, '"finished", "length"')
+    assert "write-ahead seam" in hits[0].message
+
+
+def test_journal_write_exempt_in_journal_module_and_elsewhere(tmp_path):
+    """journal.py owns its internals (recovery / compaction), and
+    non-serving files are out of scope entirely."""
+    src = """
+    class RequestJournal:
+        def _replay_helper(self):
+            self.append_terminal("f1", "finished", "length")
+    """
+    report = lint_src(tmp_path, src, name="journal.py",
+                      subdir="inference/serving")
+    assert not rules_at(report, "journal-write")
+    report = lint_src(tmp_path, src, name="other.py")
+    assert not rules_at(report, "journal-write")
+
+
 def test_terminal_write_scoped_to_serving(tmp_path):
     src = """
     class RequestState:

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Seeded chaos fuzzer for the serving fleet (``tools/chaos_fuzz.py``).
+
+The chaos suite so far drills hand-picked single faults (one kill, one
+wedge, one poisoned promotion). This tool generates RANDOMIZED fault
+schedules — fault type x tag x step x replica drawn from the existing
+``DS_FAULT`` vocabulary, seeded so every episode replays bit-for-bit —
+runs each against a small in-process fleet with the request journal
+armed, and asserts the GLOBAL invariants after every episode:
+
+1. every submitted request reaches a terminal state (re-served
+   elsewhere counts; nothing hangs, nothing vanishes);
+2. zero leaked and zero stranded pages on EVERY replica, dead or alive
+   (``check_consistent`` spans both KV tiers);
+3. at most one resident compile per surviving replica, zero recompile-
+   sentinel alarms — incidents are runtime events, never recompiles;
+4. the journal replay CONVERGES to the same terminal set the live
+   router reports: every finished fid is terminal on disk with the
+   same delivered tokens, and nothing is left non-terminal.
+
+Schedules may also draw a ``router_crash`` event: the fuzzer then
+abandons the router mid-episode (modeling process death — the replica
+engines are rebuilt cold) and drives a FRESH fleet through
+``ServingRouter.recover`` on the same journal; the invariants above
+must hold across the crash, which is exactly the claim the journal
+exists to make.
+
+Usage::
+
+  python tools/chaos_fuzz.py --episodes 50 --seed 7     # the slow bar
+  python tools/chaos_fuzz.py --episodes 2 --requests 6  # tier-1 smoke
+
+Exit 0 = every episode green; exit 1 = an invariant failed (the
+episode's seed + schedule are printed — rerun with the same ``--seed``
+and ``--episodes`` to replay it exactly).
+"""
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the schedule vocabulary: (spec template, needs). Steps and replica
+#: indices are filled per draw; seconds are kept short so a 50-episode
+#: run stays minutes, not hours. slow_step needs the watchdog armed
+#: (the engines below always arm it), corrupt faults ride the logit
+#: guard, replica_kill rides the router's chaos probe.
+_FAULTS = (
+    "replica_kill:step={step}:replica={replica}:tag=serving_fleet",
+    "slow_step:seconds=0.4:fails=1:tag=serving_step",
+    "corrupt_logits:fails=1:tag=serving_step",
+    "corrupt_logits:fails=1:tag=serving_prefill",
+    "flaky_prefill:fails={fails}:tag=serving_prefill",
+    "slow_step:p=0.15:seconds=0.05:tag=serving_step",
+)
+
+
+def draw_schedule(rng: random.Random, n_replicas: int, horizon: int):
+    """One episode's fault schedule: 1-3 DS_FAULT specs plus maybe a
+    router-crash step (executed by the fuzzer, not the env var)."""
+    specs = []
+    for _ in range(rng.randint(1, 3)):
+        t = rng.choice(_FAULTS)
+        specs.append(t.format(step=rng.randint(2, max(3, horizon)),
+                              replica=rng.randrange(n_replicas),
+                              fails=rng.randint(1, 2)))
+    crash_step = rng.randint(3, max(4, horizon)) \
+        if rng.random() < 0.4 else None
+    return specs, crash_step
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+def _check(cond, what, detail=None):
+    if not cond:
+        raise InvariantViolation(f"{what}" + (f": {detail}" if detail
+                                              is not None else ""))
+
+
+def run_episode(engine, vocab, ep: int, seed: int, n_replicas: int,
+                n_requests: int, journal_root: str) -> dict:
+    """One seeded episode; raises InvariantViolation on any red light."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import (RouterConfig,
+                                                 ServingConfig, init_fleet,
+                                                 replay_journal)
+    from deepspeed_tpu.utils import fault_injection
+
+    rng = random.Random(f"{seed}/{ep}")
+    horizon = 4 * n_requests
+    specs, crash_step = draw_schedule(rng, n_replicas, horizon)
+    jdir = os.path.join(journal_root, f"ep{ep:04d}")
+
+    def build():
+        scfg = ServingConfig(max_batch_size=2, block_size=8, num_blocks=48,
+                             max_model_len=96, prefix_cache=True,
+                             step_watchdog_s=3.0)
+        return init_fleet(
+            engine, n_replicas, serving_config=scfg,
+            router_config=RouterConfig(journal_dir=jdir,
+                                       revive_after_steps=6,
+                                       max_redispatches=8,
+                                       outage_fail_steps=40))
+
+    rs = np.random.RandomState(seed * 1000 + ep)
+    prompts = [rs.randint(1, vocab, int(rs.randint(6, 16)))
+               for _ in range(n_requests)]
+
+    prev = os.environ.get("DS_FAULT")
+    prev_seed = os.environ.get("DS_FAULT_SEED")
+    os.environ["DS_FAULT"] = ",".join(specs)
+    os.environ["DS_FAULT_SEED"] = str(seed * 100 + ep)
+    fault_injection.reset()
+    crashed = False
+    try:
+        router = build()
+        fids = []
+        i = 0
+        steps = 0
+        while i < len(prompts) or router.has_work():
+            while i < len(prompts) and len(router.queue) < 3:
+                fids.append(router.submit(prompts[i], max_new_tokens=6))
+                i += 1
+            if crash_step is not None and steps == crash_step \
+                    and not crashed:
+                # router-process death, in-process: abandon the router
+                # and every replica engine (a real crash loses exactly
+                # this state — the journal is all that survives), then
+                # recover a COLD fleet from the journal directory
+                crashed = True
+                router.journal.close()
+                del router
+                fault_injection.reset()  # fresh process, fresh streams
+                router = build()
+                recovered = router.recover()
+                # every fid not yet terminal on disk must come back
+                live_on_disk = {e.fid for e
+                                in replay_journal(jdir).values()
+                                if not e.done}
+                _check(set(recovered) == live_on_disk,
+                       "recovery missed journaled live requests",
+                       (sorted(recovered), sorted(live_on_disk)))
+            if router.has_work():
+                router.step()
+            steps += 1
+            _check(steps < 120 * n_requests, "episode wedged (no "
+                   "terminal convergence)", {"steps": steps})
+        # revive everything for the invariant sweep (a dead replica's
+        # pool must ALSO be clean — kill returns pages like the OS does)
+        for idx in range(n_replicas):
+            router.revive_replica(idx)
+        outs = {f: router.poll(f) for f in fids}
+        return finish_episode(ep, specs, crash_step, crashed, router,
+                              outs, jdir, steps)
+    finally:
+        if prev is None:
+            os.environ.pop("DS_FAULT", None)
+        else:
+            os.environ["DS_FAULT"] = prev
+        if prev_seed is None:
+            os.environ.pop("DS_FAULT_SEED", None)
+        else:
+            os.environ["DS_FAULT_SEED"] = prev_seed
+        fault_injection.reset()
+
+
+def finish_episode(ep, specs, crash_step, crashed, router, outs, jdir,
+                   steps) -> dict:
+    from deepspeed_tpu.inference.serving import replay_journal
+
+    by_state = {}
+    for o in outs.values():
+        by_state[o.state] = by_state.get(o.state, 0) + 1
+    # 1. every request terminal
+    _check(all(o.state in ("finished", "failed", "timeout")
+               for o in outs.values()), "non-terminal request",
+           {f: o.state for f, o in outs.items()
+            if o.state not in ("finished", "failed", "timeout")})
+    # 2. zero leaked / stranded pages anywhere (both tiers)
+    router.check_consistent()
+    for rep in router.replicas:
+        _check(rep.engine.block_pool.used_count == 0,
+               f"leaked pages on {rep.name}",
+               rep.engine.block_pool.used_count)
+    # 3. one resident compile per survivor, sentinel silent
+    for rep in router.replicas:
+        cc = rep.engine.compile_counts.get("mixed_step", 0)
+        _check(cc <= 1, f"extra resident compile on {rep.name}",
+               dict(rep.engine.compile_counts))
+        _check(rep.engine.perf.recompile_total == 0,
+               f"recompile sentinel fired on {rep.name}")
+    # 4. journal replay converges to the live terminal set
+    disk = replay_journal(jdir)
+    _check(all(e.done for e in disk.values()),
+           "journal left non-terminal records",
+           [f for f, e in disk.items() if not e.done])
+    for fid, o in outs.items():
+        ent = disk.get(fid)
+        _check(ent is not None, f"journal lost request {fid}")
+        _check(ent.state == o.state, f"journal/router state diverge "
+               f"for {fid}", (ent.state, o.state))
+        if o.state == "finished":
+            _check(ent.tokens == o.tokens,
+                   f"journal watermark diverges for {fid}",
+                   (ent.tokens, o.tokens))
+    return {"episode": ep, "schedule": specs, "crash_step": crash_step,
+            "crashed": crashed, "steps": steps, "by_state": by_state,
+            "requeued": router.metrics.requests_requeued,
+            "recovered": router.metrics.requests_recovered,
+            "kills": router.metrics.replica_kills}
+
+
+def run_episodes(episodes: int, seed: int, n_replicas: int = 2,
+                 n_requests: int = 8,
+                 journal_root: str = None, verbose: bool = True) -> list:
+    """Library entry (the tier-1 smoke test calls this): runs the
+    episodes, returns their summaries, raises on the first violation."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(remat=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ds.init_inference(model, params=params, dtype="fp32")
+
+    own_root = journal_root is None
+    root = journal_root or tempfile.mkdtemp(prefix="chaos_fuzz_")
+    results = []
+    try:
+        for ep in range(episodes):
+            t0 = time.perf_counter()
+            rec = run_episode(engine, cfg.vocab_size, ep, seed,
+                              n_replicas, n_requests, root)
+            rec["wall_s"] = round(time.perf_counter() - t0, 3)
+            results.append(rec)
+            if verbose:
+                print(json.dumps(rec), flush=True)
+    finally:
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="seeded DS_FAULT schedule fuzzer over a small "
+                    "serving fleet (global invariants asserted per "
+                    "episode)")
+    ap.add_argument("--episodes", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per episode")
+    ap.add_argument("--journal-root", default=None,
+                    help="keep per-episode journals here (default: a "
+                         "temp dir, removed on exit)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    try:
+        results = run_episodes(args.episodes, args.seed,
+                               n_replicas=args.replicas,
+                               n_requests=args.requests,
+                               journal_root=args.journal_root)
+    except InvariantViolation as e:
+        print(f"chaos_fuzz: INVARIANT VIOLATED — {e}", file=sys.stderr)
+        print(f"chaos_fuzz: replay with --seed {args.seed} "
+              f"--episodes {args.episodes} --replicas {args.replicas} "
+              f"--requests {args.requests}", file=sys.stderr)
+        return 1
+    wall = time.perf_counter() - t0
+    crashes = sum(1 for r in results if r["crashed"])
+    print(json.dumps({
+        "episodes": len(results), "seed": args.seed,
+        "router_crashes": crashes,
+        "kills": sum(r["kills"] for r in results),
+        "requeued": sum(r["requeued"] for r in results),
+        "recovered": sum(r["recovered"] for r in results),
+        "wall_s": round(wall, 2),
+        "verdict": "all invariants green",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
