@@ -263,6 +263,42 @@ def speculation_report() -> None:
               f"{st['pages_dropped']} pages rolled back)")
 
 
+def quantization_report() -> None:
+    """Quantized-serving status of every live ServingEngine: weight mode,
+    byte shift, and the PER-LAYER reconstruction-error table from load
+    time (``inference/quant.py``) — so a bad checkpoint or scale bug is
+    named here at startup instead of debugged from logits. Per-process
+    like the program table: call from inside a serving process (or a
+    test)."""
+    from deepspeed_tpu.inference.serving import live_serving_engines
+
+    engines = [srv for srv in live_serving_engines()
+               if srv.quant_status()["enabled"]]
+    if not engines:
+        return  # nothing to report; stay silent like the program table
+    for srv in engines:
+        st = srv.quant_status()
+        coll = "int8 collectives" if st["collectives"] else "fp collectives"
+        if not st["weights"]:
+            print(f"quantization: weights fp, {coll} "
+                  f"(mp={st['mp_size']})")
+            continue
+        print(f"quantization: weights {st['weights']} "
+              f"({st.get('leaves', 0)} kernels, "
+              f"{st.get('quant_weight_bytes', 0)} B = "
+              f"{st.get('bytes_ratio', 0):.2f}x of bf16), {coll} "
+              f"(mp={st['mp_size']})")
+        report = getattr(srv.engine, "quant_report", None) or []
+        if report:
+            print(f"{'quantized kernel':<48}{'group':>6}{'bytes':>10}"
+                  f"{'max_abs_err':>13}{'rel_err':>10}")
+            for row in report:
+                print(f"{row['param']:<48}{row['group']:>6}"
+                      f"{row['quant_bytes']:>10}"
+                      f"{row['max_abs_err']:>13.4e}"
+                      f"{row['rel_err']:>10.4e}")
+
+
 def kv_tier_report() -> None:
     """Tiered-KV status of every live ServingEngine in this process: one
     row per tier (capacity, occupancy, demote/promote counters) plus the
@@ -411,6 +447,7 @@ def main(argv=None):
     dslint_report()
     perf_report()
     speculation_report()
+    quantization_report()
     kv_tier_report()
     journal_report()
     fleet_report()

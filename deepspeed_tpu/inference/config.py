@@ -70,6 +70,33 @@ class DeepSpeedInferenceConfig:
     #: of a hoisted bf16 copy — halves decode weight bandwidth for per-token
     #: dequant compute. Off by default; measure per chip.
     dequant_per_step: bool = False
+    #: quantized weight STORAGE for serving ("int8" | "int4" | None):
+    #: attention/MLP projection kernels are absmax-quantized at
+    #: init_inference (per output channel; int4 packs two codes per byte
+    #: with grouped scales) and dequantized IN THE CONSUMER — the XLA
+    #: reference multiplies codes*scales inline, the TPU path streams
+    #: codes through the Pallas grouped-dequant matmul
+    #: (ops/pallas/quant_matmul.py). Scales ride as separate pytree
+    #: leaves sharded with their kernels, so TP partitioning is
+    #: unchanged. Embeddings/norms/lm_head stay fp. Unlike the legacy
+    #: ``quantize`` (grouped-flat whole-tree, TP-incompatible), this mode
+    #: keeps the param tree TP-sliceable. Per-layer reconstruction error
+    #: is reported at load time (engine.quant_report / ds_report).
+    quantize_weights: Optional[str] = None
+    #: scale-group length along K for quantize_weights (0 = per-column
+    #: for int8, 64 for int4); row-parallel kernels align the group to
+    #: the TP shard width automatically
+    quantize_group_size: int = 0
+    #: EQuARX-style quantized TP collectives (arxiv 2506.17615): the
+    #: row-parallel o_proj/down_proj partial-sum all-reduce — THE
+    #: per-token wire cost of multi-chip serving — moves int8 payloads +
+    #: blockwise fp32 scales instead of full-width floats
+    #: (comm/quantized.py quantized_psum). No-op at mp_size 1; the comm
+    #: tracing histograms (comm_op_s{dtype,bytes_bucket}) show the mix
+    #: shift. Composes freely with quantize_weights.
+    quantized_collectives: bool = False
+    #: quantized_psum wire block (values per absmax scale)
+    quantized_psum_block: int = 256
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # accepted for parity; XLA always compiles
     #: escape hatch for the TP/GQA guard: ``mp_size > num_key_value_heads``
@@ -97,8 +124,19 @@ class DeepSpeedInferenceConfig:
         if self.decode_loop not in ("while", "scan"):
             raise ValueError(f"decode_loop must be 'while' or 'scan', got "
                              f"{self.decode_loop!r}")
+        if self.quantize_weights not in (None, "int8", "int4"):
+            raise ValueError(
+                f"quantize_weights must be None, 'int8' or 'int4', got "
+                f"{self.quantize_weights!r}")
         self.dtype = resolve_dtype(self.dtype)
         # dtype=int8 means weight quantization, never a value-cast of float
         # weights to int8 (reference auto-sets quantize when dtype==torch.int8).
         if self.dtype == jnp.int8:
             self.quantize = True
+        # checked AFTER the dtype=int8 auto-set so dtype="int8" +
+        # quantize_weights cannot slip past as a doubly-quantized tree
+        if self.quantize_weights and self.quantize:
+            raise ValueError(
+                "quantize_weights and the legacy grouped-flat quantize are "
+                "mutually exclusive (quantize_weights keeps the tree "
+                "TP-sliceable; quantize flattens it)")

@@ -87,26 +87,59 @@ class InferenceEngine:
         # across shards; XLA's SPMD partitioner then mis-partitions the
         # repeat_kv broadcast-reshape and the forward silently computes
         # WRONG logits (r7 TP-numerics investigation: max |dlogit| ~2.4 on
-        # the tiny model at mp=4/Hkv=2, vs ~1e-6 whenever mp | Hkv — that
-        # is PROVEN wrong, not merely suspect, so it is a hard reject).
-        # Non-divisible configs that still fit under the kv-head count are
-        # untested territory rather than a proven failure: warn loudly.
+        # the tiny model at mp=4/Hkv=2, vs ~1e-6 whenever mp | Hkv). FIX
+        # (the Megatron answer): when the degrees divide, REPLICATE each
+        # kv head across the shards that share it — k/v projection weights
+        # duplicate head blocks contiguously (inference/quant.py
+        # replicate_kv_heads, the repeat_kv order) and the model rebuilds
+        # with num_key_value_heads = mp_size, so every shard owns whole
+        # heads, repeat_kv shards evenly, and most real GQA checkpoints
+        # (Hkv=8) serve at real TP widths. The KV cache grows by the
+        # replication factor — the standard Megatron trade. Non-divisible
+        # configs keep the hard reject: a silently-wrong forward must be
+        # unreachable by accident.
+        import dataclasses as _dc
+
         n_kv = getattr(getattr(module, "config", None),
                        "num_key_value_heads", None)
+        self.kv_head_replication = 1
         if n_kv is not None and self.mp_world_size > n_kv:
-            msg = (f"mp_size={self.mp_world_size} > num_key_value_heads="
-                   f"{n_kv}: each TP shard would own a FRACTION of a GQA "
-                   f"kv head, and XLA's SPMD partitioner is proven to "
-                   f"mis-partition the repeat_kv broadcast-reshape there "
-                   f"(silently wrong logits; see ROADMAP: TP numerics). "
-                   f"Use mp_size <= {n_kv}, or replicate kv heads across "
-                   f"TP shards (Megatron-style kv-head duplication in the "
-                   f"partition rules + attention core) before raising TP. "
-                   f"Pass allow_unsafe_tp=True only to reproduce the "
-                   f"known-wrong numerics.")
-            if not getattr(config, "allow_unsafe_tp", False):
-                raise ValueError(msg)
-            log_dist(f"WARNING (allow_unsafe_tp): {msg}", ranks=[0])
+            n_heads = getattr(module.config, "num_attention_heads", 0)
+            head_dim = getattr(module.config, "head_dim", None)
+            divisible = (self.mp_world_size % n_kv == 0
+                         and n_heads % self.mp_world_size == 0)
+            if divisible and head_dim is not None and \
+                    _dc.is_dataclass(module.config) and params is not None:
+                from .quant import replicate_kv_heads
+
+                rep = self.mp_world_size // n_kv
+                params = replicate_kv_heads(params, n_kv, head_dim, rep)
+                module = type(module)(_dc.replace(
+                    module.config, num_key_value_heads=self.mp_world_size))
+                self.module = module
+                self.kv_head_replication = rep
+                log_dist(
+                    f"TP/GQA: replicating {n_kv} kv heads x{rep} across "
+                    f"mp_size={self.mp_world_size} shards (Megatron-style; "
+                    f"KV cache grows x{rep})", ranks=[0])
+            else:
+                why = (f"the degrees do not divide (need mp_size % Hkv == "
+                       f"0 and heads % mp_size == 0)") if not divisible \
+                    else ("kv-head replication needs a dataclass model "
+                          "config with head_dim and params at init")
+                msg = (f"mp_size={self.mp_world_size} > "
+                       f"num_key_value_heads={n_kv} and {why}, so kv heads "
+                       f"cannot be replicated across TP shards: each shard "
+                       f"would own a FRACTION of a GQA "
+                       f"kv head, and XLA's SPMD partitioner is proven to "
+                       f"mis-partition the repeat_kv broadcast-reshape "
+                       f"there (silently wrong logits; see ROADMAP: TP "
+                       f"numerics). Use a replicable config, or pass "
+                       f"allow_unsafe_tp=True only to reproduce the "
+                       f"known-wrong numerics.")
+                if not getattr(config, "allow_unsafe_tp", False):
+                    raise ValueError(msg)
+                log_dist(f"WARNING (allow_unsafe_tp): {msg}", ranks=[0])
         elif n_kv is not None and self.mp_world_size > 1 and \
                 n_kv % self.mp_world_size != 0:
             log_dist(
@@ -115,6 +148,54 @@ class InferenceEngine:
                 f"and TP logits are known to diverge from single-device "
                 f"(see ROADMAP: TP numerics). Use mp_size <= {n_kv} with "
                 f"mp_size | {n_kv}.", ranks=[0])
+
+        # ---- quantized serving modes (ROADMAP "Quantized everything"):
+        # rebuild the module with the quant knobs so its projection
+        # layers read quantized storage / reduce over int8 payloads, and
+        # rewrite the fp param tree into codes + wscale leaves ----------
+        qw = getattr(config, "quantize_weights", None)
+        qc = bool(getattr(config, "quantized_collectives", False))
+        self.quant_report = None
+        self.quant_summary: Dict[str, Any] = {}
+        if qw or qc:
+            mcfg = getattr(module, "config", None)
+            if mcfg is None or not _dc.is_dataclass(mcfg) or \
+                    not hasattr(mcfg, "quantize_weights"):
+                raise ValueError(
+                    "quantize_weights/quantized_collectives need a model "
+                    "config that carries the quant knobs (the Llama and "
+                    "GPT-2 families)")
+            if qw and not hasattr(module, "quantizable_projections"):
+                raise ValueError(
+                    f"{type(module).__name__} declares no quantizable "
+                    f"projections; quantize_weights supports the Llama "
+                    f"and GPT-2 families")
+            module = type(module)(_dc.replace(
+                mcfg, quantize_weights=qw,
+                quantize_group_size=getattr(config, "quantize_group_size",
+                                            0),
+                quantized_collectives=qc,
+                quantized_psum_block=getattr(config,
+                                             "quantized_psum_block", 256),
+                quantize_row_shards=self.mp_world_size))
+            self.module = module
+        if qw:
+            from .quant import quant_report_summary, quantize_param_tree
+
+            if params is None:
+                raise ValueError("quantize_weights needs params at init")
+            params, self.quant_report = quantize_param_tree(
+                params, module, qw,
+                getattr(config, "quantize_group_size", 0),
+                self.mp_world_size)
+            self.quant_summary = quant_report_summary(self.quant_report)
+            log_dist(
+                f"quantize_weights={qw}: {self.quant_summary['leaves']} "
+                f"projection kernels -> "
+                f"{self.quant_summary['quant_weight_bytes']} B "
+                f"({self.quant_summary['bytes_ratio']:.2f}x of bf16), "
+                f"max rel err {self.quant_summary['max_rel_err']:.3e} "
+                f"({self.quant_summary['worst_param']})", ranks=[0])
 
         # ---- shard + cast params (reference: _convert_to_dtype :464 and
         # ReplaceWithTensorSlicing per-rank slicing) -----------------------
@@ -142,10 +223,18 @@ class InferenceEngine:
             self._dequant_meta = None
         shapes = jax.eval_shape(lambda: params)
         self.param_shardings, _ = state_shardings(shapes, mesh, None, rules)
-        params = jax.tree_util.tree_map(
-            lambda p: jnp.asarray(p, dtype)
-            if (not config.quantize and jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating))
-            else jnp.asarray(p), params)
+
+        def _cast(path, p):
+            p = jnp.asarray(p)
+            if config.quantize or not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            # quantized-weight scales stay fp32: they carry the whole
+            # dynamic range of their int8/int4 codes
+            if str(getattr(path[-1], "key", "")) == "wscale":
+                return p
+            return jnp.asarray(p, dtype)
+
+        params = jax.tree_util.tree_map_with_path(_cast, params)
         self.params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
 
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -174,6 +263,10 @@ class InferenceEngine:
     def forward(self, *args, **kwargs):
         """Plain (non-cached) forward, jitted. Reference: ``engine.forward``
         :515 (input broadcast over MP ranks is implicit under SPMD)."""
+        # re-pin THIS engine's mesh: model code (QuantDense tp_reduce,
+        # mixtral expert gating) consults the process-global mesh at
+        # trace time, and a later-constructed engine may have replaced it
+        set_mesh(self.mesh)
         if self._forward_jit is None:
             def fwd(params, args, kwargs):
                 if self._dequant_meta is not None:
@@ -313,6 +406,9 @@ class InferenceEngine:
         zeros on the left) so the last column is the newest token for every
         row — positions and key masking handle the pads.
         """
+        # same mesh re-pin as forward(): the generate programs trace
+        # lazily, possibly after another engine replaced the global mesh
+        set_mesh(self.mesh)
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]

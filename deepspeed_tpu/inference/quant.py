@@ -1,0 +1,144 @@
+"""Serving-time weight transforms: quantize projection kernels at
+``init_inference`` (and replicate GQA kv heads for wide TP).
+
+``quantize_param_tree`` rewrites an fp param tree into the layout
+``models/layers.py QuantDense`` consumes — each projection ``kernel``
+becomes absmax codes (int8, or packed int4 two-per-byte along K) plus a
+sibling ``wscale`` leaf of fp32 grouped scales — and returns a per-layer
+error report so a bad checkpoint or scale bug is NAMED at startup
+(``ds_report`` / the serving final report) instead of debugged from
+logits. The model families declare WHAT quantizes via
+``quantizable_projections(config)``: embeddings, norms and the lm_head
+stay fp (they are a sliver of the bytes and carry the quality).
+
+Scale-group alignment: row-parallel kernels (o_proj/down_proj — K
+sharded over ``model``) resolve their group against the PER-SHARD K so a
+scale group never straddles a TP shard; group count then divides the TP
+width and the QuantDense shard_map seam can hand each shard its own
+groups.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pallas.quant_matmul import (dequantize_linear_weight,
+                                       effective_group_size,
+                                       quantize_linear_weight)
+
+
+def _match_role(path: str, specs) -> Optional[str]:
+    for pattern, role in specs:
+        if re.search(pattern, path):
+            return role
+    return None
+
+
+def quantize_param_tree(params: Dict, module, mode: str, group_size: int,
+                        mp_size: int = 1
+                        ) -> Tuple[Dict, List[Dict[str, Any]]]:
+    """Quantize every projection kernel of ``params`` in place of its fp
+    leaf (codes under the original ``kernel`` name + a ``wscale``
+    sibling) and report per-leaf reconstruction error.
+
+    Returns ``(new_params, report)`` where each report row carries the
+    leaf path, mode, effective group, fp/quantized byte counts and the
+    max-abs / relative reconstruction error (max over elements, and over
+    layers for scanned leaves).
+    """
+    import flax.traverse_util as trav
+
+    specs = module.quantizable_projections(module.config)
+    flat = trav.flatten_dict(params, sep="/")
+    out: Dict[str, Any] = {}
+    report: List[Dict[str, Any]] = []
+    for path, leaf in flat.items():
+        role = _match_role(path, specs)
+        if role is None:
+            out[path] = leaf
+            continue
+        w = jnp.asarray(leaf)
+        if w.ndim not in (2, 3):
+            raise ValueError(
+                f"quantizable projection {path} has ndim {w.ndim}; "
+                f"expected [K, N] or scanned [L, K, N]")
+        k = w.shape[-2]
+        shards = mp_size if role == "row" else 1
+        g = effective_group_size(k, mode, group_size, shards)
+
+        def q1(w2, g=g):
+            return quantize_linear_weight(w2, mode, g)
+
+        if w.ndim == 3:
+            q, s = jax.vmap(q1)(w)
+            dq = jax.vmap(lambda a, b: dequantize_linear_weight(
+                a, b, mode))(q, s)
+        else:
+            q, s = q1(w)
+            dq = dequantize_linear_weight(q, s, mode)
+        amax = float(jnp.max(jnp.abs(w.astype(jnp.float32))))
+        max_abs_err = float(jnp.max(jnp.abs(
+            dq - w.astype(jnp.float32))))
+        out[path] = q
+        out[re.sub(r"kernel$", "wscale", path)] = s
+        report.append({
+            "param": path,
+            "mode": mode,
+            "group": g,
+            "fp_bytes": int(w.size) * 2,  # as served (bf16 compute copy)
+            "quant_bytes": int(q.size) * q.dtype.itemsize
+            + int(s.size) * 4,
+            "max_abs_err": max_abs_err,
+            "rel_err": max_abs_err / max(amax, 1e-12),
+        })
+    return trav.unflatten_dict(out, sep="/"), report
+
+
+def quant_report_summary(report: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll a :func:`quantize_param_tree` report up to the block every
+    surface prints (``ds_report``, ds_serve final report, the bench
+    artifact): total byte shift + the worst leaf by relative error."""
+    if not report:
+        return {}
+    worst = max(report, key=lambda r: r["rel_err"])
+    return {
+        "mode": report[0]["mode"],
+        "leaves": len(report),
+        "fp_bytes": int(sum(r["fp_bytes"] for r in report)),
+        "quant_weight_bytes": int(sum(r["quant_bytes"] for r in report)),
+        "bytes_ratio": round(sum(r["quant_bytes"] for r in report)
+                             / max(sum(r["fp_bytes"] for r in report), 1),
+                             4),
+        "max_rel_err": worst["rel_err"],
+        "worst_param": worst["param"],
+    }
+
+
+def replicate_kv_heads(params: Dict, num_kv_heads: int, head_dim: int,
+                       rep: int) -> Dict:
+    """Megatron-style GQA kv-head replication for TP widths beyond the
+    kv-head count: every ``k_proj``/``v_proj`` kernel (and qkv bias)
+    ``[..., Hkv * D]`` expands to ``[..., Hkv * rep * D]`` by repeating
+    each head block ``rep`` times CONTIGUOUSLY — the order
+    ``models/layers.py repeat_kv`` produces, so query head ``i`` keeps
+    attending its original kv head ``i // (H / Hkv)`` exactly. With the
+    replicated count equal to ``mp_size`` every TP shard owns whole kv
+    heads and XLA's SPMD partitioner has no fractional-head
+    broadcast-reshape left to mis-partition (the r7 divergence)."""
+    import flax.traverse_util as trav
+
+    flat = trav.flatten_dict(params, sep="/")
+    out: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        if re.search(r"(k_proj|v_proj)/(kernel|bias)$", path):
+            w = jnp.asarray(leaf)
+            lead = w.shape[:-1]
+            heads = w.reshape(lead + (num_kv_heads, head_dim))
+            out[path] = jnp.repeat(heads, rep, axis=len(lead)).reshape(
+                lead + (num_kv_heads * rep * head_dim,))
+        else:
+            out[path] = leaf
+    return trav.unflatten_dict(out, sep="/")

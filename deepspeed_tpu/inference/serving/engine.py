@@ -983,6 +983,13 @@ class ServingEngine:
         fault_injection.maybe_stall("stall", tag="serving_step",
                                     step=self._step_no,
                                     stream=self.fault_stream)
+        # re-pin THIS engine's mesh before any lazy program build: model
+        # code (QuantDense tp_reduce, mixtral expert gating) consults the
+        # process-global mesh at trace time, and another engine
+        # constructed since may have replaced it
+        from ...parallel.topology import set_mesh
+
+        set_mesh(self.engine.mesh)
         t0 = time.perf_counter()
 
         # 1. deadline sweep: queued requests past deadline are shed at the
@@ -2201,6 +2208,28 @@ class ServingEngine:
             "promote_wait_p95_s": hist.percentile(0.95)
             if hist.count else None,
         }
+
+    def quant_status(self) -> Dict[str, Any]:
+        """Quantized-serving block for CLI reports (``ds_serve`` final
+        report, ``ds_report``, /statusz): weight mode + byte shift +
+        worst-leaf reconstruction error (the load-time accounting from
+        ``inference/quant.py``), and whether the TP collectives ride
+        int8 payloads. ``enabled`` False when both modes are off."""
+        icfg = self.engine.config
+        qw = getattr(icfg, "quantize_weights", None)
+        qc = bool(getattr(icfg, "quantized_collectives", False))
+        out: Dict[str, Any] = {
+            "enabled": bool(qw or qc),
+            "weights": qw,
+            "collectives": qc,
+            "mp_size": self.engine.mp_world_size,
+        }
+        if qc:
+            out["psum_block"] = getattr(icfg, "quantized_psum_block", 256)
+        summary = getattr(self.engine, "quant_summary", None)
+        if summary:
+            out.update(summary)
+        return out
 
     def _write_table_row(self, req: Request) -> None:
         row = np.full((self.nb_max,), self.block_pool.sentinel, np.int32)

@@ -18,7 +18,8 @@ from .layers import (cached_attention_xla,
                      flash_prefill_from_empty,
                      cross_entropy_loss, dot_product_attention,
                      init_kv_cache, init_paged_kv_cache, is_paged_index,
-                     key_mask_to_bias, paged_attention_reference,
+                     key_mask_to_bias, model_dense,
+                     paged_attention_reference,
                      paged_prefill_attention_reference,
                      ragged_mixed_attention_reference,
                      shift_labels, update_kv_cache, update_paged_kv_cache)
@@ -45,6 +46,12 @@ class GPT2Config:
     remat: bool = False
     #: >0: chunked training loss (models/layers.py); 0 = plain
     loss_chunk: int = 0
+    # -- quantized serving (set via init_inference; see LlamaConfig) ----
+    quantize_weights: Optional[str] = None
+    quantize_group_size: int = 0
+    quantized_collectives: bool = False
+    quantized_psum_block: int = 256
+    quantize_row_shards: int = 1
 
     @staticmethod
     def gpt2_125m(**over):
@@ -64,7 +71,7 @@ class GPT2Attention(nn.Module):
         cfg = self.config
         B, T, C = x.shape
         H, D = cfg.n_head, cfg.n_embd // cfg.n_head
-        qkv = nn.Dense(3 * C, name="c_attn", param_dtype=jnp.float32)(x)
+        qkv = model_dense(cfg, 3 * C, "c_attn", use_bias=True)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
@@ -119,7 +126,8 @@ class GPT2Attention(nn.Module):
                                         dropout_rng=rng, dropout_rate=cfg.attn_pdrop,
                                         deterministic=deterministic)
         out = out.reshape(B, T, C)
-        out = nn.Dense(C, name="c_proj", param_dtype=jnp.float32)(out)
+        out = model_dense(cfg, C, "c_proj", use_bias=True,
+                          row_parallel=True)(out)
         if cfg.resid_pdrop > 0 and not deterministic:
             out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
         return out, layer_cache
@@ -131,9 +139,10 @@ class GPT2MLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True):
         cfg = self.config
-        h = nn.Dense(4 * cfg.n_embd, name="c_fc", param_dtype=jnp.float32)(x)
+        h = model_dense(cfg, 4 * cfg.n_embd, "c_fc", use_bias=True)(x)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(cfg.n_embd, name="c_proj", param_dtype=jnp.float32)(h)
+        h = model_dense(cfg, cfg.n_embd, "c_proj", use_bias=True,
+                        row_parallel=True)(h)
         if cfg.resid_pdrop > 0 and not deterministic:
             h = nn.Dropout(cfg.resid_pdrop)(h, deterministic=False)
         return h
@@ -244,10 +253,26 @@ class GPT2LMHeadModel(nn.Module):
     @staticmethod
     def partition_rules(config: GPT2Config):
         L = (None,) if config.scan_layers else ()
-        return [
+        rules = [
             (r"wte/embedding", P("model", None)),
             (r"attn/c_attn/kernel", P(*L, None, "model")),
             (r"attn/c_proj/kernel", P(*L, "model", None)),
             (r"mlp/c_fc/kernel", P(*L, None, "model")),
             (r"mlp/c_proj/kernel", P(*L, "model", None)),
+        ]
+        if getattr(config, "quantize_weights", None):
+            # see LlamaForCausalLM.partition_rules: column-parallel scales
+            # shard on N with their kernels; row-parallel scales replicate
+            rules += [
+                (r"(attn/c_attn|mlp/c_fc)/wscale", P(*L, None, "model")),
+                (r"(attn|mlp)/c_proj/wscale", P(*L, None, None)),
+            ]
+        return rules
+
+    @staticmethod
+    def quantizable_projections(config: GPT2Config):
+        """See ``LlamaForCausalLM.quantizable_projections``."""
+        return [
+            (r"(attn/c_attn|mlp/c_fc)/kernel$", "col"),
+            (r"(attn|mlp)/c_proj/kernel$", "row"),
         ]

@@ -43,6 +43,187 @@ def resolve_remat_policy(name: str):
     return policies[name]
 
 
+class QuantDense(nn.Module):
+    """``nn.Dense`` whose kernel may be STORED quantized and whose TP
+    reduction may ride the quantized collective — the serving path's
+    projection layer (``models/llama.py`` / ``gpt2.py`` build every
+    attention/MLP projection through :func:`model_dense`).
+
+    With ``quantize=None`` and ``tp_reduce=None`` this is parameter- and
+    math-identical to ``nn.Dense`` (same ``kernel``/``bias`` names, inits
+    and shapes), so fp checkpoints and partition rules are untouched.
+
+    ``quantize="int8"|"int4"``: the ``kernel`` param holds absmax codes
+    (int8 ``[K, N]``, or uint8 ``[K//2, N]`` packed two int4 per byte
+    along K) and a sibling ``wscale`` param holds fp32 grouped scales
+    ``[G, N]`` (``ops/pallas/quant_matmul.quantize_linear_weight``
+    produces both; ``inference.engine.init_inference`` rewrites fp param
+    trees into this layout). Dequantization happens in the CONSUMER:
+    the XLA reference path multiplies codes by scales inline (fused into
+    the matmul operand read — CPU tier-1 stays token-exact-testable
+    against it), and ``dequant_impl="pallas"`` on TPU streams the codes
+    through the grouped-dequant matmul kernel (int8/int4 in HBM,
+    dequantized per K-block in VMEM — the KV cache's int8 pattern
+    applied to the projection operands).
+
+    ``tp_reduce="quantized"``: a ROW-parallel projection (o_proj /
+    down_proj — input features sharded over ``model``) runs its matmul
+    inside ``shard_map`` and reduces partial sums with
+    :func:`~deepspeed_tpu.comm.quantized.quantized_psum` (int8 wire
+    payloads) instead of the partitioner's full-width psum. Engages only
+    when the active mesh's ``model`` axis is > 1; the bias (replicated)
+    is added AFTER the reduction.
+    """
+
+    features: int
+    use_bias: bool = True
+    quantize: Optional[str] = None      # None | "int8" | "int4"
+    group_size: int = 0                 # scale group along K (0 = default)
+    dequant_impl: str = "xla"           # "xla" | "pallas"
+    #: input features sharded over `model` (o_proj/down_proj): scale
+    #: groups align to the TP shard width, and tp_reduce may engage
+    row_parallel: bool = False
+    #: the TP width the weights were QUANTIZED for (config-carried, not
+    #: read from the mutable global mesh: two engines of different mp in
+    #: one process must each validate their own scale shapes)
+    row_shards: int = 1
+    tp_reduce: Optional[str] = None     # None | "quantized"
+    psum_block: int = 256               # quantized_psum wire block
+    param_dtype: Any = jnp.float32
+
+    def _model_axis(self):
+        from ..parallel.topology import get_mesh
+
+        mesh = get_mesh()
+        mp = 1 if mesh is None else dict(
+            zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
+        return mesh, mp
+
+    def _matmul(self, x, kernel, wscale):
+        """Local (per-shard, under tp_reduce) quantized-or-plain matmul."""
+        if self.quantize is None:
+            return x @ kernel.astype(x.dtype)
+        if self.dequant_impl == "pallas" and \
+                jax.default_backend() == "tpu":
+            from ..ops.pallas.quant_matmul import quant_matmul
+
+            lead = x.shape[:-1]
+            y = quant_matmul(x.reshape(-1, x.shape[-1]), kernel, wscale,
+                             self.quantize)
+            return y.reshape(lead + (y.shape[-1],))
+        from ..ops.pallas.quant_matmul import dequantize_linear_weight
+
+        return x @ dequantize_linear_weight(kernel, wscale, self.quantize,
+                                            x.dtype)
+
+    @nn.compact
+    def __call__(self, x):
+        feats, mode = self.features, self.quantize
+        K = x.shape[-1]
+        if mode is None:
+            kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                                (K, feats), self.param_dtype)
+            wscale = None
+        else:
+            from ..ops.pallas.quant_matmul import effective_group_size
+
+            # init produces zero codes / unit scales of the right SHAPES
+            # (a from-scratch init of a quantized model is only ever used
+            # for shape inference; real codes come from init_inference's
+            # quantization of fp master weights). The group derivation is
+            # SHARED with inference/quant.py — row-parallel kernels align
+            # groups to `row_shards`, the TP width the engine quantized
+            # for — so the wscale shape flax validates always matches
+            # what the engine wrote.
+            rows = K // 2 if mode == "int4" else K
+            kdtype = jnp.uint8 if mode == "int4" else jnp.int8
+            shards = self.row_shards if self.row_parallel else 1
+            g = effective_group_size(K, mode, self.group_size, shards)
+            kernel = self.param(
+                "kernel", lambda rng, shape, dtype: jnp.zeros(shape, dtype),
+                (rows, feats), kdtype)
+            wscale = self.param("wscale", nn.initializers.ones,
+                                (K // g, feats), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feats,),
+                          self.param_dtype) if self.use_bias else None
+
+        mesh = None
+        if self.tp_reduce is not None:
+            mesh, mp = self._model_axis()
+            if mp <= 1:
+                mesh = None  # world size 1: plain path, zero overhead
+        if mesh is None:
+            y = self._matmul(x, kernel, wscale)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from ..comm.quantized import quantized_psum
+            from ..utils.jax_compat import shard_map
+
+            # row-parallel seam: x's features and the kernel's K dim (the
+            # packed dim for int4) split over `model`; each shard matmuls
+            # its slice and the partial sums reduce over int8 payloads.
+            # Scales ride [G, N]: sharded along G when the groups split
+            # evenly (engine-aligned int4 grouping), else replicated —
+            # either way the dequant uses each shard's own K-groups.
+            xspec = P(*((None,) * (x.ndim - 1)), "model")
+            kspec = P("model", None)
+            block = self.psum_block
+
+            if wscale is None:
+                def body(xl, kl):
+                    return quantized_psum(self._matmul(xl, kl, None),
+                                          "model", block=block)
+
+                y = shard_map(body, mesh=mesh, in_specs=(xspec, kspec),
+                              out_specs=P(*((None,) * x.ndim)),
+                              check_vma=False)(x, kernel)
+            else:
+                sspec = P("model", None) if wscale.shape[0] % mp == 0 \
+                    else P(None, None)
+
+                def body(xl, kl, sl):
+                    return quantized_psum(self._matmul(xl, kl, sl),
+                                          "model", block=block)
+
+                y = shard_map(body, mesh=mesh,
+                              in_specs=(xspec, kspec, sspec),
+                              out_specs=P(*((None,) * x.ndim)),
+                              check_vma=False)(x, kernel, wscale)
+        if bias is not None:
+            y = y + bias
+        return y
+
+
+def model_dense(cfg, feats: int, name: str, use_bias: bool = False,
+                row_parallel: bool = False):
+    """The ONE projection-layer factory the model families share.
+
+    Returns a plain ``nn.Dense`` unless the model config asks for
+    quantized weights (``quantize_weights``) or — on a ROW-parallel
+    projection — quantized TP collectives (``quantized_collectives``),
+    in which case a :class:`QuantDense` carries the corresponding mode.
+    Keeping the fp path on literal ``nn.Dense`` guarantees existing
+    param trees, inits and checkpoints are byte-identical.
+    """
+    quant = getattr(cfg, "quantize_weights", None)
+    qcoll = bool(getattr(cfg, "quantized_collectives", False)) and \
+        row_parallel
+    if quant is None and not qcoll:
+        return nn.Dense(feats, use_bias=use_bias, name=name,
+                        param_dtype=jnp.float32)
+    return QuantDense(
+        feats, use_bias=use_bias, name=name, quantize=quant,
+        group_size=getattr(cfg, "quantize_group_size", 0),
+        dequant_impl="pallas"
+        if getattr(cfg, "decode_attention_impl", "xla") == "pallas"
+        else "xla",
+        row_parallel=row_parallel,
+        row_shards=getattr(cfg, "quantize_row_shards", 1),
+        tp_reduce="quantized" if qcoll else None,
+        psum_block=getattr(cfg, "quantized_psum_block", 256))
+
+
 class RMSNorm(nn.Module):
     """RMS LayerNorm (Llama-style)."""
 

@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from .layers import (RMSNorm, apply_rotary,
                      cached_attention_xla, flash_prefill_from_empty,
-                     cross_entropy_loss, lm_head_output,
+                     cross_entropy_loss, lm_head_output, model_dense,
                      dot_product_attention, init_kv_cache,
                      init_paged_kv_cache, is_paged_index, key_mask_to_bias,
                      paged_attention_reference,
@@ -88,6 +88,30 @@ class LlamaConfig:
     #: size — the [tokens, vocab] logits tensor is never materialized
     #: (models/layers.py chunked_cross_entropy_loss). 0 = plain loss.
     loss_chunk: int = 0
+    # -- quantized serving (set via init_inference, never by hand: the
+    # engine rewrites the fp param tree to match) ----------------------
+    #: store attention/MLP projection kernels quantized ("int8" per-channel
+    #: codes, or "int4" packed two-per-byte with grouped scales) with
+    #: dequant fused into the consumer matmul (models/layers.py QuantDense;
+    #: Pallas grouped-dequant kernel when decode_attention_impl="pallas").
+    #: Embeddings, norms and the lm_head stay fp.
+    quantize_weights: Optional[str] = None
+    #: scale-group length along K for quantized weights (0 = one group =
+    #: per-output-column). int4 accuracy wants grouping (e.g. 64); the
+    #: engine aligns the effective group to the TP shard width.
+    quantize_group_size: int = 0
+    #: EQuARX-style quantized TP collectives: the row-parallel o_proj /
+    #: down_proj partial sums all-reduce over int8 wire payloads
+    #: (comm/quantized.py quantized_psum) instead of the partitioner's
+    #: full-width psum. No-op at model-axis world size 1.
+    quantized_collectives: bool = False
+    #: quantized_psum wire block (values per absmax scale on the wire)
+    quantized_psum_block: int = 256
+    #: the TP width the quantized weights were written for (set by
+    #: init_inference; row-parallel scale groups align to it — carried
+    #: in the config so param-shape validation never consults the
+    #: mutable process-global mesh)
+    quantize_row_shards: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -128,8 +152,8 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         B, T, _ = x.shape
         H, Hkv, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        dense = lambda feats, name, bias=False: nn.Dense(
-            feats, use_bias=bias, name=name, param_dtype=jnp.float32)
+        dense = lambda feats, name, bias=False, row=False: model_dense(
+            cfg, feats, name, use_bias=bias, row_parallel=row)
         qb = cfg.attention_qkv_bias
         q = dense(H * D, "q_proj", qb)(x).reshape(B, T, H, D)
         k = dense(Hkv * D, "k_proj", qb)(x).reshape(B, T, Hkv, D)
@@ -272,7 +296,7 @@ class LlamaAttention(nn.Module):
                                         flash_block_k=cfg.flash_block_k,
                                         window=cfg.sliding_window)
         out = out.reshape(B, T, H * D)
-        return dense(cfg.hidden_size, "o_proj")(out), layer_cache
+        return dense(cfg.hidden_size, "o_proj", row=True)(out), layer_cache
 
 
 class LlamaMLP(nn.Module):
@@ -281,13 +305,13 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name,
-                                             param_dtype=jnp.float32)
+        dense = lambda feats, name, row=False: model_dense(
+            cfg, feats, name, use_bias=False, row_parallel=row)
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
         act = nn.silu if cfg.mlp_activation == "silu" else \
             (lambda g: nn.gelu(g, approximate=True))  # gemma gelu_pytorch_tanh
-        return dense(cfg.hidden_size, "down_proj")(act(gate) * up)
+        return dense(cfg.hidden_size, "down_proj", row=True)(act(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -456,9 +480,32 @@ class LlamaForCausalLM(nn.Module):
         layout ``module_inject/replace_module.py:190`` slices for inference.
         """
         L = (None,) if config.scan_layers else ()
-        return [
+        rules = [
             (r"embed_tokens/embedding", P("model", None)),
             (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", P(*L, None, "model")),
             (r"(o_proj|down_proj)/kernel", P(*L, "model", None)),
             (r"lm_head/kernel", P(None, "model")),
+        ]
+        if getattr(config, "quantize_weights", None):
+            # quantized-weight scales ride as sibling [G, N] leaves:
+            # column-parallel scales shard on N exactly like their
+            # kernels; row-parallel scales replicate (G may be 1 —
+            # per-column — which no axis divides; they are KB-sized, and
+            # the QuantDense shard_map seam re-slices its own groups)
+            rules += [
+                (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/wscale",
+                 P(*L, None, "model")),
+                (r"(o_proj|down_proj)/wscale", P(*L, None, None)),
+            ]
+        return rules
+
+    @staticmethod
+    def quantizable_projections(config: "LlamaConfig"):
+        """(path_regex, role) of every kernel ``init_inference`` may
+        store quantized. Roles drive scale-group/TP alignment: "col" =
+        output features on ``model``, "row" = input features on
+        ``model`` (see ``inference/quant.py``)."""
+        return [
+            (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$", "col"),
+            (r"(o_proj|down_proj)/kernel$", "row"),
         ]

@@ -470,6 +470,10 @@ def serving_statusz(srv) -> str:
     if tiers.get("enabled"):
         lines.append(f"kv_tiers: {json.dumps(tiers['tiers'])}")
         lines.append("")
+    quant = srv.quant_status()
+    if quant.get("enabled"):
+        lines.append(f"quantization: {json.dumps(quant)}")
+        lines.append("")
     lines.append("metrics snapshot:")
     for k, v in sorted(srv.metrics.snapshot().items()):
         lines.append(f"  {k} = {v:g}")

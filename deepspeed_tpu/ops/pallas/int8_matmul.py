@@ -14,6 +14,10 @@ HBM never sees a dequantized copy of the weights.
 
 Off-TPU the public entry falls back to dequantize+matmul (same math);
 interpret mode is used for kernel parity tests.
+
+The GROUPED generalization (grouped scales, packed int4) used by the
+quantized serving path lives in ``quant_matmul.py``; this kernel keeps
+the per-column factor-out fast path.
 """
 
 import functools

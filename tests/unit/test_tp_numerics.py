@@ -1,21 +1,24 @@
-"""TP numerics: pins the r7 investigation of the mp_size=4 logit
-divergence (ROADMAP open item).
+"""TP numerics: pins the r7 GQA head-split investigation AND its fix.
 
-Findings (fp32 tiny Llama, virtual CPU mesh):
+History (fp32 tiny Llama, virtual CPU mesh):
 
-- The old "reduction-order / RMSNorm accumulation" hypothesis is
-  REFUTED: whenever ``mp_size`` divides ``num_key_value_heads``, TP
-  logits match single-device to ~1e-6 — that is the true size of psum
+- The old "reduction-order / RMSNorm accumulation" hypothesis was
+  REFUTED in r7: whenever ``mp_size`` divides ``num_key_value_heads``,
+  TP logits match single-device to ~1e-6 — that is the true size of psum
   reduction-order noise, and RMSNorm already accumulates in fp32.
-- The real cause is GQA head splitting: ``mp_size=4`` over
-  ``num_key_value_heads=2`` gives each shard HALF a kv head; XLA's SPMD
-  partitioner mis-partitions the ``repeat_kv`` broadcast-reshape over the
-  unevenly-sharded head axis and the forward silently computes wrong
-  logits (max |dlogit| ~2.4, ~65% of logit scale; greedy tokens flip).
-
-These tests pin both sides so any movement is visible: a partitioner or
-model fix makes the divergence test FAIL (tight it up then!), a
-regression in the divisible path fails the parity tests.
+- The real cause was GQA head splitting: ``mp_size=4`` over
+  ``num_key_value_heads=2`` gave each shard HALF a kv head; XLA's SPMD
+  partitioner mis-partitioned the ``repeat_kv`` broadcast-reshape and
+  the forward silently computed wrong logits (max |dlogit| ~2.4, ~65%
+  of logit scale; greedy tokens flipped). PR 4 hard-rejected the config.
+- FIXED (r16): when the degrees divide (``mp % Hkv == 0`` and
+  ``heads % mp == 0``), ``init_inference`` REPLICATES each kv head
+  across the shards that shared it (Megatron-style;
+  ``inference/quant.py replicate_kv_heads``) and rebuilds the model with
+  ``num_key_value_heads = mp_size`` — every shard owns whole heads, and
+  the divergence falls into the same reduction-order band as divisible
+  TP (measured ~2e-6; pinned at 1e-4 below). Non-divisible configs keep
+  the hard reject: a silently-wrong forward stays unreachable.
 """
 
 import numpy as np
@@ -28,16 +31,14 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 from deepspeed_tpu.parallel import build_mesh
 
-# the multi-shard forward comparisons are slow-tier; the init-time guard
-# test stays in tier-1 so a silent revert of the hard reject can't pass CI
+# the multi-shard forward comparisons are slow-tier; the init-time
+# replication/reject tests stay in tier-1 so a silent revert can't pass CI
 
 #: reduction-order noise bound for divisible TP on the fp32 tiny model
-#: (measured ~1.5e-6; 1e-4 leaves margin for XLA version drift)
+#: (measured ~1.5e-6; 1e-4 leaves margin for XLA version drift). Since
+#: r16 kv-head REPLICATION puts mp > Hkv configs in the same band — the
+#: pinned ~2.4 divergence of the r7 investigation is gone.
 DIVISIBLE_TP_TOL = 1e-4
-#: pinned band of the known mp=4/Hkv=2 divergence (measured max ~2.38):
-#: above the band = got worse, below = the partitioner/model was fixed —
-#: either way, look
-KNOWN_DIVERGENCE_LO, KNOWN_DIVERGENCE_HI = 0.05, 4.0
 
 
 def _logits(cfg, params, prompt, **init_kw):
@@ -76,7 +77,7 @@ def test_tp_divisible_kv_heads_matches_single_device():
 @pytest.mark.slow
 def test_tp4_mha_matches_single_device():
     """mp=4 with Hkv=4 (no GQA split): also exact to reduction order —
-    the divergence is NOT a property of mp=4 itself."""
+    the r7 divergence was never a property of mp=4 itself."""
     cfg, params, prompt = _setup(num_key_value_heads=4)
     single = _logits(cfg, params, prompt)
     tp4 = _logits(cfg, params, prompt, mp_size=4,
@@ -86,45 +87,65 @@ def test_tp4_mha_matches_single_device():
 
 
 @pytest.mark.slow
-def test_tp4_gqa_head_split_divergence_pinned():
-    """mp=4 over Hkv=2 splits kv heads across shards: the SPMD-partitioned
-    repeat_kv mis-computes and logits diverge. Pin the current bound: a
-    FAIL below the band means the stack got fixed (tighten to
-    DIVISIBLE_TP_TOL and drop the init-time guard); above means it got
-    even worse. ``allow_unsafe_tp=True`` is exactly for this repro — the
-    engine hard-rejects the config otherwise."""
+def test_tp4_gqa_replication_matches_single_device():
+    """THE r16 fix, tightened from the old pinned ~2.4 divergence band:
+    mp=4 over Hkv=2 now replicates kv heads (x2) at init and the TP
+    forward matches single-device inside the SAME reduction-order band
+    as divisible TP (measured ~2e-6). If this fails loose, the
+    replication transform or the rebuilt head mapping broke; if an
+    engine guard reappears, the init below raises instead."""
     cfg, params, prompt = _setup()  # tiny default: Hkv=2
     assert cfg.num_key_value_heads == 2
     single = _logits(cfg, params, prompt)
-    tp4 = _logits(cfg, params, prompt, mp_size=4, allow_unsafe_tp=True,
+    tp4 = _logits(cfg, params, prompt, mp_size=4,
                   mesh=build_mesh(data=2, model=4))
     d = np.abs(single - tp4).max()
-    assert KNOWN_DIVERGENCE_LO < d < KNOWN_DIVERGENCE_HI, (
-        f"mp=4/Hkv=2 divergence moved out of its pinned band: {d:.4g} "
-        f"(band {KNOWN_DIVERGENCE_LO}..{KNOWN_DIVERGENCE_HI}); if it "
-        f"shrank below the band the partitioner bug is fixed — tighten "
-        f"this test and remove the engine guard")
+    assert d < DIVISIBLE_TP_TOL, (
+        f"mp=4/Hkv=2 with kv-head replication diverged {d:.4g} from "
+        f"single-device (band {DIVISIBLE_TP_TOL}); the Megatron "
+        f"replication transform no longer reproduces the repeat_kv "
+        f"head mapping")
+    assert (single.argmax(-1) == tp4.argmax(-1)).all()
 
 
-def test_tp_beyond_kv_heads_hard_rejected():
-    """The proven-wrong case is a hard REJECT at init, not a warning: a
-    silently-wrong forward must be impossible to reach by accident. The
-    error names the kv-head-replication workaround; allow_unsafe_tp=True
-    is the only way through (pinned above)."""
+def test_tp_beyond_kv_heads_replicates_or_rejects():
+    """Init-time contract of mp_size > num_key_value_heads: DIVISIBLE
+    degrees replicate (engine reports the factor, the rebuilt model
+    carries Hkv = mp, the KV caches size to it); NON-divisible degrees
+    stay a hard reject — each shard would own a fraction of a kv head,
+    the proven-wrong SPMD case, and a silently-wrong forward must be
+    impossible to reach by accident."""
     from deepspeed_tpu.parallel import topology
 
-    cfg, params, prompt = _setup()  # tiny default: Hkv=2
+    cfg, params, prompt = _setup()  # tiny default: Hkv=2, H=4
     topology.set_mesh(None, None)
     topology._CURRENT_TOPOLOGY = None
-    with pytest.raises(ValueError, match="replicate kv heads"):
+    eng = ds.init_inference(LlamaForCausalLM(cfg), params=params,
+                            dtype="fp32", mp_size=4,
+                            mesh=build_mesh(data=2, model=4))
+    assert eng.kv_head_replication == 2
+    assert eng.module.config.num_key_value_heads == 4
+    # the replicated k_proj kernel doubled its head dim
+    import flax.traverse_util as trav
+
+    flat = trav.flatten_dict(jax.tree_util.tree_map(
+        lambda x: x.shape, eng.params), sep="/")
+    k_shape = flat["model/layers/block/self_attn/k_proj/kernel"]
+    assert k_shape[-1] == 4 * cfg.head_dim
+    topology.set_mesh(None, None)
+    topology._CURRENT_TOPOLOGY = None
+
+    # H=4 % mp=8 != 0: fractional-head case stays rejected
+    with pytest.raises(ValueError, match="FRACTION of a GQA kv head"):
         ds.init_inference(LlamaForCausalLM(cfg), params=params, dtype="fp32",
-                          mp_size=4, mesh=build_mesh(data=2, model=4))
+                          mp_size=8, mesh=build_mesh(data=1, model=8))
     topology.set_mesh(None, None)
     topology._CURRENT_TOPOLOGY = None
-    # mp_size=2 divides Hkv=2: still admitted, no escape hatch needed
+    # mp_size=2 divides Hkv=2: still admitted, no replication needed
     eng = ds.init_inference(LlamaForCausalLM(cfg), params=params,
                             dtype="fp32", mp_size=2,
                             mesh=build_mesh(data=4, model=2))
     assert eng.mp_world_size == 2
+    assert eng.kv_head_replication == 1
     topology.set_mesh(None, None)
     topology._CURRENT_TOPOLOGY = None

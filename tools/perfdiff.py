@@ -73,7 +73,11 @@ HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
                     "accept_rate", "spec_tokens_per_verify")
 
 LOWER_IS_BETTER = ("ttft", "latency", "wall", "overhead", "shed_rate",
-                   "timeout_rate", "step_p", "evictions")
+                   "timeout_rate", "step_p", "evictions",
+                   # quantized serving (r16): the weight-storage byte
+                   # footprint of the quantized projection kernels —
+                   # growing it back toward fp is a lost compression win
+                   "quant_weight_bytes")
 
 #: meta/bookkeeping keys excluded from gating entirely. The perf block's
 #: per-CALL utilization gauges (tokens_per_sec_per_chip / mixed_step_mfu
@@ -126,7 +130,20 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         # the gated durability signal is journal_overhead_pct via
         # ABS_BARS, plus the shared step/ttft keys). The per-arm step
         # medians ride the ordinary lower-is-better _s rules.
-        "crash_drill.", "fsync_per_admission", "recover_wall")
+        "crash_drill.", "fsync_per_admission", "recover_wall",
+        # quantized serving (r16): parity-band and bookkeeping keys are
+        # NOT perf directions — token_match/max_rel_err are accuracy
+        # bands the bench asserts in-run (a band is a contract, not a
+        # trend to gate), bytes_ratio/fp_bytes/leaves/group are
+        # configuration-determined byte accounting (quant_weight_bytes
+        # alone gates, lower-is-better above), the comm_mix table and
+        # the computed wire ratio are deterministic shape math, and the
+        # per-mode tok/s legs are the 1-core box's noise (the
+        # deterministic parity/compile asserts are the gate)
+        "token_match", "max_rel_err", "bytes_ratio", "fp_bytes",
+        ".leaves", ".group", "comm_mix", "wire_bytes_ratio",
+        "parity_band", "psum_block", "quant_sweep.modes.",
+        "quant_sweep.fp_decode_tokens_per_sec")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
