@@ -1,7 +1,7 @@
 from .block_pool import BlockPool, BlockPoolError  # noqa: F401
 from .scheduler import (RejectedError, Request, RequestState,  # noqa: F401
                         Scheduler, TERMINAL_STATES)
-from .metrics import ServingMetrics  # noqa: F401
+from .metrics import AutoscalerMetrics, ServingMetrics  # noqa: F401
 from .kv_tiers import HostTier, KVTier  # noqa: F401
 from .speculative import Drafter, PromptLookupDrafter  # noqa: F401
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
@@ -9,9 +9,13 @@ from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      live_serving_engines)
 from .journal import (JournalCorruptionError, JournalEntry,  # noqa: F401
                       JournalLockedError, RequestJournal,
-                      live_request_journals, replay_journal)
+                      live_request_journals, replay_journal,
+                      replay_scale_state)
 from .replica import Replica  # noqa: F401
 from .router import (FleetMetrics, FleetOutput, FleetRequest,  # noqa: F401
                      RouterConfig, ServingRouter, init_fleet,
                      live_serving_routers)
-from .fleet import copy_kv_pages, transfer_prefix_kv  # noqa: F401
+from .fleet import (chain_tokens, copy_kv_pages,  # noqa: F401
+                    transfer_host_prefix_kv, transfer_prefix_kv,
+                    warm_prefix_kv)
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401

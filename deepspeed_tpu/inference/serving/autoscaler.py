@@ -1,0 +1,268 @@
+"""Elastic fleet autoscaler: replica count follows load, crash-safely.
+
+The fleet below this layer is whatever size it was built; this is the
+elasticity story (the reference's ``deepspeed/elasticity``, reframed for
+serving): a control loop that watches the signals the router already
+scrapes — fleet queue depth, rolling ``slo_burn_rate``, brownout-band KV
+occupancy, goodput — and grows or shrinks the replica set through the
+router's journaled scale ladders (``scale_out`` / ``scale_in``).
+
+The policy is deliberately boring, because a fleet-size actuator that
+overreacts is worse than none:
+
+- **hysteresis bands** — scale-out triggers on HIGH thresholds
+  (queue/replica, burn rate, occupancy), scale-in only when every signal
+  is under its LOW threshold; the gap between the bands is where
+  flapping traffic lives without moving the fleet;
+- **patience** — a threshold must hold for N consecutive ticks
+  (``out_patience`` / ``in_patience``, with in > out: adding capacity
+  late queues requests, removing it early thrashes) before the policy
+  acts; any tick back inside the bands resets the counter;
+- **cooldown** — after ANY transition the policy holds for
+  ``cooldown_steps`` ticks, long enough for the last action's effect to
+  show up in the signals it acts on (the classic
+  control-loop-faster-than-the-plant failure);
+- **one transition at a time** — while a scale-in is draining dry the
+  policy only observes (the router completes the retire; acting on a
+  fleet mid-transition double-counts capacity).
+
+Crash safety is the router's: every transition is write-ahead journaled
+(intent / done / abort), so a kill -9 mid-scale recovers to a consistent
+membership — the autoscaler itself keeps NO durable state and simply
+resumes observing after ``recover()``.
+
+Scale-out warmup is deliberate, not lazy: the router pre-transfers the
+fleet's hottest prefix chains onto the new replica (device pages and
+host-tier pages both — ``fleet.warm_prefix_kv``), then its
+fewest-ever-routed tiebreak finishes the slow-start with real traffic.
+
+Drive it one ``tick()`` per router step (``bin/ds_serve --autoscale``
+does); every tick returns the action taken (``"scale_out"`` /
+``"scale_in"`` / None) so callers can log decisions as they happen.
+"""
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .metrics import AutoscalerMetrics
+from .router import ServingRouter
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Knobs of the elastic fleet policy. The defaults assume the
+    in-process tick cadence benches and tests drive (one tick per router
+    step); a wall-clock deployment scales the patience/cooldown counts
+    to its scrape interval."""
+
+    #: fleet-size bounds (inclusive). min >= 1: an autoscaler must never
+    #: scale a serving fleet to nothing
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: scale-OUT band (any signal past its high -> pressure):
+    #: fleet-queued requests per active replica
+    queue_high: float = 3.0
+    #: mean rolling SLO burn rate across active replicas
+    burn_high: float = 0.5
+    #: mean KV occupancy across active replicas (the brownout
+    #: neighborhood — past it admission is already degrading)
+    occupancy_high: float = 0.85
+    #: scale-IN band (EVERY signal under its low -> idle). The gap
+    #: between the bands is the hysteresis dead zone
+    queue_low: float = 0.5
+    burn_low: float = 0.05
+    occupancy_low: float = 0.30
+    #: consecutive pressure ticks before a scale-out
+    out_patience: int = 3
+    #: consecutive idle ticks before a scale-in (deliberately larger:
+    #: adding capacity late queues requests, removing it early thrashes)
+    in_patience: int = 10
+    #: ticks the policy holds after ANY completed decision
+    cooldown_steps: int = 16
+    #: hottest prefix chains pre-warmed onto a scaled-out replica
+    warm_chains: int = 8
+
+    def validate(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1 (an autoscaler "
+                             "never scales a serving fleet to nothing)")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(f"max_replicas ({self.max_replicas}) < "
+                             f"min_replicas ({self.min_replicas})")
+        if self.queue_low > self.queue_high or \
+                self.burn_low > self.burn_high or \
+                self.occupancy_low > self.occupancy_high:
+            raise ValueError("every low threshold must sit at or under "
+                             "its high (the hysteresis band)")
+        if self.out_patience < 1 or self.in_patience < 1:
+            raise ValueError("patience counts must be >= 1")
+        if self.cooldown_steps < 0:
+            raise ValueError("cooldown_steps must be >= 0")
+
+
+class Autoscaler:
+    """The fleet-size control loop over one :class:`ServingRouter`."""
+
+    def __init__(self, router: ServingRouter,
+                 config: Optional[AutoscalerConfig] = None):
+        self.router = router
+        self.cfg = config or AutoscalerConfig()
+        self.cfg.validate()
+        self.metrics = AutoscalerMetrics()
+        #: the export surface discovers the policy through the router
+        #: (``monitor/export.py`` renders ``ds_autoscale_*`` when set)
+        router.autoscaler = self
+        #: consecutive ticks of pressure / idle (the patience counters)
+        self._hot = 0
+        self._cold = 0
+        #: ticks left before the policy may act again
+        self._cooldown = 0
+
+    # -- signals -------------------------------------------------------
+
+    def signals(self) -> Dict[str, float]:
+        """The decision inputs, scraped from the router's replica probe
+        surface — exactly what the routing policy itself runs on."""
+        active = [r for r in self.router.replicas
+                  if r.alive and not r.retired]
+        n = max(1, len(active))
+        burn = occ = goodput = 0.0
+        queued = len(self.router.queue)
+        for r in active:
+            s = r.signals()
+            burn += s["slo_burn_rate"]
+            occ += s["kv_occupancy"]
+            goodput += s["goodput_tokens_per_sec"]
+            # the WHOLE waiting backlog, wherever it waits: dispatch
+            # moves fleet-queue heads into replica queues eagerly, so
+            # the fleet queue alone understates pressure
+            queued += s["queue_depth"]
+        return {
+            "active": float(len(active)),
+            "total": float(len(self.router.replicas)),
+            "queue_per_replica": queued / n,
+            "mean_burn_rate": burn / n,
+            "mean_occupancy": occ / n,
+            "fleet_goodput_tokens_per_sec": goodput,
+        }
+
+    # -- the control loop ----------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """Evaluate the bands once and act at most once; call after each
+        router step. Returns ``"scale_out"`` / ``"scale_in"`` when a
+        transition was initiated this tick, else None."""
+        m = self.metrics
+        cfg = self.cfg
+        m.ticks += 1
+        s = self.signals()
+        active = int(s["active"])
+        m.fleet_active = active
+        m.fleet_total = int(s["total"])
+        m.queue_per_replica = s["queue_per_replica"]
+        m.mean_burn_rate = s["mean_burn_rate"]
+        m.mean_occupancy = s["mean_occupancy"]
+        m.fleet_goodput_tokens_per_sec = s["fleet_goodput_tokens_per_sec"]
+
+        pressure = (s["queue_per_replica"] >= cfg.queue_high
+                    or s["mean_burn_rate"] >= cfg.burn_high
+                    or s["mean_occupancy"] >= cfg.occupancy_high)
+        idle = (s["queue_per_replica"] <= cfg.queue_low
+                and s["mean_burn_rate"] <= cfg.burn_low
+                and s["mean_occupancy"] <= cfg.occupancy_low
+                and not self.router.queue)
+        # the patience counters run even while held (cooldown/pending):
+        # a burst that persists THROUGH the cooldown acts immediately
+        # after it, rather than restarting its patience clock
+        if pressure:
+            self._hot += 1
+            self._cold = 0
+            m.pressure_ticks += 1
+        elif idle:
+            self._cold += 1
+            self._hot = 0
+            m.idle_ticks += 1
+        else:
+            self._hot = 0
+            self._cold = 0
+
+        if self.router._pending_scale_in:
+            # one transition at a time: a fleet mid-drain double-counts
+            # capacity in every signal above
+            m.holds_pending += 1
+            return None
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            if self._hot >= cfg.out_patience or \
+                    self._cold >= cfg.in_patience:
+                m.holds_cooldown += 1
+            return None
+
+        if self._hot >= cfg.out_patience:
+            if active >= cfg.max_replicas:
+                m.holds_bounds += 1
+                return None
+            self.router.scale_out(reason=self._reason(s, pressure=True),
+                                  warm_chains=cfg.warm_chains)
+            m.scale_out_decisions += 1
+            self._hot = 0
+            self._cooldown = cfg.cooldown_steps
+            return "scale_out"
+        if self._cold >= cfg.in_patience:
+            if active <= cfg.min_replicas:
+                m.holds_bounds += 1
+                return None
+            victim = self._pick_victim()
+            if victim is None:
+                return None
+            if self.router.scale_in(victim,
+                                    reason=self._reason(s, pressure=False)):
+                m.scale_in_decisions += 1
+                self._cold = 0
+                self._cooldown = cfg.cooldown_steps
+                return "scale_in"
+        return None
+
+    def _pick_victim(self) -> Optional[int]:
+        """Least-loaded active replica, ties to the HIGHEST index (LIFO:
+        shrink the most recently grown slot first — it holds the least
+        affinity history, and slot reuse keeps indices compact)."""
+        active = [r for r in self.router.replicas
+                  if r.alive and not r.retired]
+        if len(active) <= 1:
+            return None
+        return min(active,
+                   key=lambda r: (r.load_score(self.router.cfg.burn_weight),
+                                  -r.idx)).idx
+
+    def _reason(self, s: Dict[str, float], pressure: bool) -> str:
+        if pressure:
+            cfg = self.cfg
+            if s["queue_per_replica"] >= cfg.queue_high:
+                return f"queue_per_replica={s['queue_per_replica']:.2f}"
+            if s["mean_burn_rate"] >= cfg.burn_high:
+                return f"burn_rate={s['mean_burn_rate']:.2f}"
+            return f"occupancy={s['mean_occupancy']:.2f}"
+        return (f"idle:queue={s['queue_per_replica']:.2f},"
+                f"occ={s['mean_occupancy']:.2f}")
+
+    # -- status --------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """One status block (ds_serve report, fleet /statusz)."""
+        cfg = self.cfg
+        return {
+            "policy": "hysteresis+cooldown",
+            "bounds": [cfg.min_replicas, cfg.max_replicas],
+            "bands": {
+                "queue_per_replica": [cfg.queue_low, cfg.queue_high],
+                "burn_rate": [cfg.burn_low, cfg.burn_high],
+                "occupancy": [cfg.occupancy_low, cfg.occupancy_high],
+            },
+            "patience": {"out": cfg.out_patience, "in": cfg.in_patience},
+            "cooldown_steps": cfg.cooldown_steps,
+            "cooldown_remaining": self._cooldown,
+            "pressure_streak": self._hot,
+            "idle_streak": self._cold,
+            "counters": self.metrics.snapshot(),
+        }

@@ -24,11 +24,12 @@ arxiv 2504.18658: sender gathers pages, receiver scatters them), so the
 fast path replaces this one function, not the router.
 """
 
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from .block_pool import ChainKey
 from .engine import ServingEngine
 
 #: reference-set owner id for pages in transit (allocated, written,
@@ -96,3 +97,69 @@ def transfer_prefix_kv(src: ServingEngine, dst: ServingEngine,
     # them — and the next admission's match_prefix revives them
     dst_pool.free(dst_ids, TRANSFER_OWNER)
     return n
+
+
+def chain_tokens(key: ChainKey) -> List[int]:
+    """The full token prefix a :class:`ChainKey` names, rebuilt by
+    walking the ``prev`` links. The autoscaler's warmup works from the
+    router's hot-chain record — ChainKeys, not prompts — and the
+    transfer helpers take tokens, so this is the bridge between them."""
+    parts = []
+    k = key
+    while k is not None:
+        parts.append(k.tokens)
+        k = k.prev
+    out: List[int] = []
+    for t in reversed(parts):
+        out.extend(t)
+    return out
+
+
+def transfer_host_prefix_kv(src: ServingEngine, dst: ServingEngine,
+                            tokens: Sequence[int]) -> int:
+    """Like :func:`transfer_prefix_kv`, but sourcing pages the donor
+    holds only in its HOST tier: payloads are read from host RAM and
+    scattered into the destination's device pool, committed + parked on
+    the cached LRU the same way. The scale-out warmup uses both — hot
+    chains live wherever the donor's two-tier LRU put them, and a new
+    replica should inherit the prefix no matter which tier serves it.
+    Returns pages transferred (0 when the donor has no host tier, holds
+    nothing for the chain, or the destination cannot take pages)."""
+    if src is dst or src.host_tier is None:
+        return 0
+    from .kv_tiers import insert_paged_block
+    src_pool, dst_pool = src.block_pool, dst.block_pool
+    hashes = src_pool.prefix_block_hashes(tokens)
+    n = 0
+    for h in hashes:
+        if dst_pool.lookup(h) is not None:
+            continue  # destination already serves this block live
+        payload = src.host_tier.get(h)
+        if payload is None:
+            # the donor can't source this block from host RAM; deeper
+            # blocks chain on it, so a gap here ends the useful prefix
+            break
+        if not dst_pool.can_allocate(1):
+            break
+        dst_ids = dst_pool.allocate(1, TRANSFER_OWNER)
+        try:
+            dst.pool = insert_paged_block(dst.pool, dst_ids, payload)
+            dst_pool.commit_hash(dst_ids[0], h)
+        except BaseException:
+            dst_pool.free(dst_ids, TRANSFER_OWNER)
+            raise
+        dst_pool.free(dst_ids, TRANSFER_OWNER)
+        n += 1
+    return n
+
+
+def warm_prefix_kv(src: ServingEngine, dst: ServingEngine,
+                   tokens: Sequence[int]) -> Tuple[int, int]:
+    """Pre-warm one prefix chain onto ``dst`` from wherever ``src``
+    holds it: device pages ride :func:`transfer_prefix_kv`, host-tier
+    pages ride :func:`transfer_host_prefix_kv` (run second — it fills
+    exactly the blocks the device pass could not source). Returns
+    (device_pages, host_pages) moved."""
+    dev = transfer_prefix_kv(src, dst, tokens)
+    host = transfer_host_prefix_kv(src, dst, tokens)
+    return dev, host

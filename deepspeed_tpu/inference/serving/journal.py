@@ -38,6 +38,21 @@ door's retry suppression survives restarts — via temp + ``os.replace``
 compacted one, never a half-write), deleting segments left empty. The
 journal's footprint tracks the LIVE request set plus that bounded
 tombstone window, not traffic volume.
+
+**Scale events** (PR 17's elastic fleet) extend the same write-ahead
+discipline to fleet MEMBERSHIP: every autoscaler transition journals an
+``intent`` record (fsync'd) BEFORE the fleet acts and a ``done`` record
+after, so a crash mid-transition recovers to a consistent replica set —
+an unfinished scale-out leaves NO ghost replica (the intent is aborted
+on recovery; capacity the fleet never acknowledged never existed), an
+unfinished scale-in leaves the replica ACTIVE (its drain died with the
+process; the requests it was shedding are themselves journaled and
+recover independently). :attr:`RequestJournal.scale_state` is the
+replayed fold: replica index -> desired membership + pending intent.
+Scale records carry no fid, so compaction keeps only the LAST record
+per replica (the fold is last-write-wins per index) and replay in an
+older reader skips them — the vocabulary is forward-compatible by the
+same rule as every other record type.
 """
 
 import io
@@ -213,6 +228,24 @@ class RequestJournal:
         #: replayed + live state: fid -> JournalEntry (insertion order ==
         #: admit order — recovery re-admits in this order)
         self.state: "Dict[str, JournalEntry]" = {}
+        #: replayed fleet-membership fold (the elastic-fleet contract):
+        #: replica idx -> {"active": Optional[bool], "pending":
+        #: Optional[op], "n": seq}. ``active`` None = the journal never
+        #: closed a transition for this replica (base fleet membership
+        #: governs); ``pending`` non-None = a crash interrupted a
+        #: transition (``ServingRouter.recover`` reconciles: an
+        #: unfinished scale-out aborts, an unfinished scale-in leaves
+        #: the replica active)
+        self.scale_state: Dict[int, Dict[str, Any]] = {}
+        #: monotone scale-record sequence (stamped as ``n`` so compaction
+        #: can tell a superseded record from the current one)
+        self.scale_appends = 0
+        #: per-replica ``n`` of the last CLOSING record (done/abort):
+        #: older scale records are compactable
+        self._scale_last_close: Dict[int, int] = {}
+        #: segment indices holding any scale record (compaction dirty
+        #: marking for membership records, which carry no fid)
+        self._scale_segs: set = set()
         #: fid -> segment indices holding any of its records; feeds the
         #: dirty-segment set so compaction never re-reads a sealed
         #: segment with nothing to shed (without it every compact() is
@@ -285,6 +318,8 @@ class RequestJournal:
         fid = payload.get("fid")
         if fid is not None:
             self._fid_segs.setdefault(fid, set()).add(self._active_idx)
+        if payload.get("t") == "scale":
+            self._scale_segs.add(self._active_idx)
         data = _encode(payload)
         f = self._open_active()
         f.write(data)
@@ -377,6 +412,32 @@ class RequestJournal:
         # something compaction can shed
         self._dirty_segs |= self._fid_segs.get(fid, set())
 
+    def append_scale(self, op: str, replica: int, phase: str,
+                     reason: str = "") -> None:
+        """Make one fleet-membership transition durable (fsync'd). The
+        WRITE-AHEAD half of the elastic-fleet contract: ``intent`` is on
+        disk BEFORE the fleet acts (spawn/activate/drain/retire) and
+        ``done`` only after the transition completed — so a crash at any
+        point recovers to a consistent replica set: no ghost replicas
+        (an unclosed scale-out aborts on recovery), no lost capacity
+        (an unclosed scale-in leaves the replica active). ``abort``
+        closes an intent without changing membership."""
+        if op not in ("out", "in"):
+            raise ValueError(f"scale op must be 'out' or 'in', got {op!r}")
+        if phase not in ("intent", "done", "abort"):
+            raise ValueError(f"scale phase must be intent|done|abort, "
+                             f"got {phase!r}")
+        payload = {"t": "scale", "op": op, "replica": int(replica),
+                   "phase": phase, "reason": reason,
+                   "n": self.scale_appends,
+                   "ts": time.time()}  # dslint: ignore[determinism] wall clock of record: journal stamps must survive the process, perf_counter does not
+        self._append(payload)
+        self._fold(payload)
+        if phase in ("done", "abort"):
+            # every scale record older than this closing one is now
+            # compactable (last-write-wins per replica index)
+            self._dirty_segs |= self._scale_segs
+
     # -- replay / recovery ---------------------------------------------
 
     def _recover_segments(self, truncate_torn: bool = True) -> None:
@@ -420,6 +481,8 @@ class RequestJournal:
                 fid = payload.get("fid")
                 if fid is not None:
                     self._fid_segs.setdefault(fid, set()).add(idx)
+                if payload.get("t") == "scale":
+                    self._scale_segs.add(idx)
                 good_bytes += len(line)
             if last and good_bytes < len(data):
                 if not truncate_torn:
@@ -489,6 +552,26 @@ class RequestJournal:
                 # terminal transition reproduces completion order — the
                 # same invariant append_terminal keeps live
                 self.state[fid] = self.state.pop(fid)
+        elif t == "scale":
+            ridx = payload.get("replica")
+            if not isinstance(ridx, int):
+                return  # malformed membership record: skip, never guess
+            n = payload.get("n")
+            n = self.scale_appends if not isinstance(n, int) else n
+            self.scale_appends = max(self.scale_appends, n + 1)
+            st = self.scale_state.setdefault(
+                ridx, {"active": None, "pending": None, "n": -1})
+            st["n"] = n
+            phase = payload.get("phase")
+            if phase == "intent":
+                st["pending"] = payload.get("op")
+            elif phase == "done":
+                st["active"] = payload.get("op") == "out"
+                st["pending"] = None
+                self._scale_last_close[ridx] = n
+            elif phase == "abort":
+                st["pending"] = None
+                self._scale_last_close[ridx] = n
         # unknown record types are skipped: a newer writer's vocabulary
         # must not brick an older reader's recovery
 
@@ -527,6 +610,7 @@ class RequestJournal:
             total = 0
             seen_fids: set = set()
             kept_fids: set = set()
+            kept_scale = False
             with open(path, "rb") as f:
                 for line in f:
                     total += 1
@@ -536,6 +620,25 @@ class RequestJournal:
                             f"invalid record in sealed journal segment "
                             f"{path} during compaction")
                     fid = payload.get("fid")
+                    if payload.get("t") == "scale":
+                        # fleet-membership record: last-write-wins per
+                        # replica index. A closing record (done/abort)
+                        # supersedes everything older for its replica,
+                        # so keep only records at or past the last
+                        # close — that is the closing record itself
+                        # plus any NEWER intent (an open transition
+                        # must survive for recovery to reconcile it).
+                        # Malformed shapes keep verbatim: not ours to
+                        # judge, mirroring the unknown-type rule.
+                        ridx = payload.get("replica")
+                        n = payload.get("n")
+                        if (isinstance(ridx, int) and isinstance(n, int)
+                                and n < self._scale_last_close.get(
+                                    ridx, -1)):
+                            continue
+                        keep.append(line)
+                        kept_scale = True
+                        continue
                     if payload.get("t") not in ("admit", "deliver",
                                                 "terminal") or fid is None:
                         # a newer writer's record vocabulary (or an
@@ -568,6 +671,8 @@ class RequestJournal:
                     if fid is not None:
                         kept_fids.add(fid)
             self._dirty_segs.discard(idx)
+            if not kept_scale:
+                self._scale_segs.discard(idx)
             if len(keep) == total:
                 continue
             for fid in seen_fids - kept_fids:
@@ -646,6 +751,8 @@ class RequestJournal:
             "non_terminal": live,
             "compactions": self.compactions,
             "records_compacted": self.records_compacted,
+            "scale_records": self.scale_appends,
+            "scale_replicas_tracked": len(self.scale_state),
             "torn_tails_truncated": self.torn_tails_truncated,
             "last_compaction_age_s":
                 None if self._last_compaction is None
@@ -686,5 +793,36 @@ def replay_journal(journal_dir: str) -> Dict[str, JournalEntry]:
     j.state = {}
     j._fid_segs = {}
     j._dirty_segs = set()
+    j.scale_state = {}
+    j.scale_appends = 0
+    j._scale_last_close = {}
+    j._scale_segs = set()
     j._recover_segments(truncate_torn=False)
     return j.state
+
+
+def replay_scale_state(journal_dir: str) -> Dict[int, Dict[str, Any]]:
+    """Read-only fold of the fleet-membership (scale) records, same
+    no-write contract as :func:`replay_journal`. The chaos fuzzer
+    compares a recovered fleet's replica set against exactly this:
+    ``active`` is True (scaled out), False (scaled in) or None (base
+    membership governs); ``pending`` non-None means the journal ends
+    mid-transition — recovery must have reconciled (aborted) it."""
+    j = RequestJournal.__new__(RequestJournal)
+    j.dir = journal_dir
+    j.segment_bytes = 1 << 20
+    j.fsync = False
+    j.appends = 0
+    j.compactions = 0
+    j.records_compacted = 0
+    j.torn_tails_truncated = 0
+    j._last_compaction = None
+    j.state = {}
+    j._fid_segs = {}
+    j._dirty_segs = set()
+    j.scale_state = {}
+    j.scale_appends = 0
+    j._scale_last_close = {}
+    j._scale_segs = set()
+    j._recover_segments(truncate_torn=False)
+    return j.scale_state

@@ -410,3 +410,38 @@ class ServingMetrics:
         from ...monitor.monitor import events_from_scalars
 
         return events_from_scalars(self.snapshot(), step, prefix="serving/")
+
+
+@dataclass
+class AutoscalerMetrics:
+    """The autoscaler's own observability block (fleet-level; the scale
+    TRANSITIONS themselves are counted on ``FleetMetrics`` because the
+    router executes them — this is the DECISION layer: what the policy
+    saw and what it chose). Exported as ``ds_autoscale_*`` by
+    ``monitor/export.py``."""
+
+    # monotone counters
+    ticks: int = 0
+    scale_out_decisions: int = 0
+    scale_in_decisions: int = 0
+    #: ticks the policy WANTED to act but the cooldown window held it
+    holds_cooldown: int = 0
+    #: ticks held because a previous transition is still in flight
+    holds_pending: int = 0
+    #: ticks held at the min/max replica bound
+    holds_bounds: int = 0
+    #: consecutive-signal accounting (hysteresis visibility)
+    pressure_ticks: int = 0
+    idle_ticks: int = 0
+    # gauges (the signals the last tick evaluated)
+    fleet_active: int = 0
+    fleet_total: int = 0
+    queue_per_replica: float = 0.0
+    mean_burn_rate: float = 0.0
+    mean_occupancy: float = 0.0
+    fleet_goodput_tokens_per_sec: float = 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        from dataclasses import fields
+        return {f.name: float(getattr(self, f.name))
+                for f in fields(self)}

@@ -58,11 +58,20 @@ class Replica:
         self.draining = False
         #: router step the last kill happened at (drives auto-revive)
         self.killed_at_step: Optional[int] = None
+        #: True after the autoscaler retired this replica (scale-in
+        #: completed): deliberately out of the fleet — never routed,
+        #: never stepped, never auto-revived, excluded from outage
+        #: counting. Distinct from dead (killed): a retired replica is
+        #: a PLANNED absence the journal records, and only
+        #: :meth:`activate` (scale-out reusing the slot) brings it back
+        self.retired = False
         # lifecycle counters (the fleet /statusz + ds_report rows)
         self.kills = 0
         self.revives = 0
         self.ejections = 0
         self.readmissions = 0
+        self.retirements = 0
+        self.activations = 0
         #: heartbeat: (engine steps, perf_counter stamp) at the last
         #: observed progress — a replica that HAS work but whose step
         #: counter stops advancing is wedged in a way /healthz may not
@@ -91,6 +100,8 @@ class Replica:
         """The router's /healthz view: (healthy, reasons). A dead replica
         is trivially unhealthy; a live one is unhealthy while the engine
         reports a wedged backend or the heartbeat went stale."""
+        if self.retired:
+            return False, ["retired"]
         if not self.alive:
             return False, ["dead"]
         reasons: List[str] = []
@@ -103,6 +114,8 @@ class Replica:
 
     def ready_reasons(self) -> List[str]:
         """The /readyz reasons, plus the router-imposed drain."""
+        if self.retired:
+            return ["retired"]
         if not self.alive:
             return ["dead"]
         _, detail = self.engine.readiness()
@@ -115,7 +128,8 @@ class Replica:
     def routable(self) -> bool:
         """May the router dispatch NEW work here at all? (Brownout and
         cold merely deprioritize — see the router's candidate ranking.)"""
-        return self.alive and not self.ejected and not self.draining
+        return (self.alive and not self.ejected and not self.draining
+                and not self.retired)
 
     def signals(self) -> Dict[str, Any]:
         """The goodput-weighted routing signals (PR 8's scrape fields):
@@ -170,7 +184,11 @@ class Replica:
         eng = self.engine
         stranded = eng.live_rids()
         for rid in stranded:
-            eng.cancel(rid, reason)
+            # always the CANONICAL kill reason, whatever the operator's
+            # label: the router's requeue funnel keys on it — a request
+            # stranded by ANY kill is the fleet's doing (re-served
+            # elsewhere), never the request's own terminal outcome
+            eng.cancel(rid, "replica_kill")
         eng.block_pool.drop_cached()
         eng.begin_drain()  # queue is already empty; this closes admission
         self.alive = False
@@ -185,8 +203,11 @@ class Replica:
 
     def revive(self) -> None:
         """Supervisor restart: reopen admission. (In-process the compiled
-        programs survive; a real restart is cold and /readyz says so.)"""
-        if self.alive:
+        programs survive; a real restart is cold and /readyz says so.)
+        Refuses a RETIRED replica: retirement is a deliberate, journaled
+        membership change — only a journaled scale-out (:meth:`activate`)
+        may undo it, never the supervisor's crash-restart path."""
+        if self.alive or self.retired:
             return
         self.alive = True
         self.ejected = False
@@ -210,6 +231,44 @@ class Replica:
         if self.alive:
             self.engine.resume_admission()
 
+    # -- retirement (the autoscaler's scale-in/out ladder) -------------
+
+    def retire(self) -> None:
+        """Graceful exit after drain ran dry: drop the warm KV (the
+        slot's memory goes back, as a decommissioned process's would),
+        close admission, leave the fleet. The engine must be DRY — the
+        autoscaler only calls this after the drain ladder finished, and
+        retiring with residents would cancel work the contract says is
+        never dropped."""
+        if self.retired:
+            return
+        if self.engine.has_work():
+            raise RuntimeError(
+                f"retire({self.name}): engine still has work — the "
+                f"drain must run dry first")
+        self.engine.block_pool.drop_cached()
+        self.engine.begin_drain()  # close admission on the parked slot
+        self.alive = False
+        self.draining = False
+        self.ejected = False
+        self.killed_at_step = None  # never auto-revived
+        self.retired = True
+        self.retirements += 1
+
+    def activate(self) -> None:
+        """Scale-out into this slot: reopen a retired (or fresh) replica
+        for traffic. In-process the resident compile survives in the
+        engine — reusing a retired slot is exactly why no scale event
+        ever pays a recompile."""
+        self.retired = False
+        self.alive = True
+        self.ejected = False
+        self.draining = False
+        self.killed_at_step = None
+        self.engine.resume_admission()
+        self.activations += 1
+        self.note_progress()
+
     def status_row(self) -> Dict[str, Any]:
         """One fleet-status table row (/statusz + ds_report)."""
         healthy, health_reasons = self.probe_health()
@@ -219,6 +278,7 @@ class Replica:
             "alive": self.alive,
             "ejected": self.ejected,
             "draining": self.draining,
+            "retired": self.retired,
             "healthy": healthy,
             "health_reasons": health_reasons,
             "ready_reasons": self.ready_reasons(),
@@ -236,4 +296,6 @@ class Replica:
             "revives": self.revives,
             "ejections": self.ejections,
             "readmissions": self.readmissions,
+            "retirements": self.retirements,
+            "activations": self.activations,
         }

@@ -65,7 +65,8 @@ import re
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -291,10 +292,19 @@ class FleetMetrics:
     #: disaggregated mode: prefill->decode hops and KV pages handed over
     disagg_hops: int = 0
     kv_pages_transferred: int = 0
+    #: elastic membership: completed scale transitions and the pages
+    #: the scale-out warmup moved (device-sourced vs host-tier-sourced)
+    scale_outs: int = 0
+    scale_ins: int = 0
+    scale_aborts: int = 0
+    scale_warm_pages: int = 0
+    scale_warm_pages_host: int = 0
     steps: int = 0
     # gauges
     queue_depth: int = 0
     in_flight: int = 0
+    replicas_total: int = 0
+    replicas_active: int = 0
 
     def snapshot(self) -> Dict[str, float]:
         return {f.name: float(getattr(self, f.name))
@@ -348,6 +358,20 @@ class ServingRouter:
         self._step_no = 0
         self._draining = False
         self._rr = 0
+        #: spawns ONE fresh ServingEngine for elastic scale-out beyond
+        #: the constructed fleet (set by :func:`init_fleet`; None =
+        #: scale-out can only reactivate retired slots)
+        self.replica_factory: Optional[Callable[[], ServingEngine]] = None
+        #: fleet-hottest prefix chains: deepest route-hash key of each
+        #: affinity dispatch, LRU-bounded — the scale-out warmup's
+        #: shopping list (which prefixes are worth pre-transferring onto
+        #: a replica that has served nothing yet)
+        self._chain_heat: "OrderedDict[ChainKey, int]" = OrderedDict()
+        self._chain_heat_cap = 64
+        #: replica idx -> reason for every scale-in whose drain is still
+        #: running dry; :meth:`step` completes (retire + journal done)
+        #: or aborts (killed mid-drain) each one
+        self._pending_scale_in: Dict[int, str] = {}
         #: consecutive ticks of total outage (queue blocked, no live
         #: replica) — drives the outage_fail_steps terminal bound
         self._outage_steps = 0
@@ -621,6 +645,7 @@ class ServingRouter:
                              "RouterConfig.journal_dir or pass "
                              "journal_dir")
         now_wall = time.time()  # dslint: ignore[determinism] wall clock of record: journaled deadlines are wall-clock so they survive the process
+        self._reconcile_scale_state()
         recovered: List[str] = []
         for ent in list(self.journal.state.values()):
             if self._materialize_entry(ent, now_wall):
@@ -631,6 +656,47 @@ class ServingRouter:
                      f"request(s) from {self.journal.dir} "
                      f"(delivered-token watermarks carried)", ranks=[0])
         return recovered
+
+    def _reconcile_scale_state(self) -> None:
+        """Settle the journaled fleet membership after a crash so the
+        recovered fleet is CONSISTENT: an unfinished scale-out leaves no
+        ghost replica (aborted — the spawned engine died with the
+        process anyway), an unfinished scale-in leaves the replica
+        active (its drain died with the process; its requests recover
+        independently through the request records), a journaled DONE
+        governs — replicas scaled out beyond the constructed fleet are
+        re-spawned, replicas scaled in are re-retired. Runs BEFORE
+        request materialization so recovered requests dispatch onto the
+        reconciled membership."""
+        for idx, st in sorted(self.journal.scale_state.items()):
+            pending = st.get("pending")
+            if pending is not None:
+                self.abort_scale(pending, idx, "crash_reconcile")
+                self.metrics.scale_aborts += 1
+                log_dist(f"fleet: recovery aborted unfinished "
+                         f"scale-{pending} of replica {idx}", ranks=[0])
+            active = st.get("active")
+            if active is None:
+                continue  # never completed a transition: base membership
+            if active:
+                while len(self.replicas) <= idx:
+                    # journaled member beyond this fleet: re-spawn it
+                    # (parked retired until ITS activation below — an
+                    # intermediate index journaled inactive must come
+                    # back retired, not alive)
+                    self.replicas[self.add_replica()].retire()
+                rep = self.replicas[idx]
+                if rep.retired or not rep.alive:
+                    rep.activate()
+            elif idx < len(self.replicas):
+                rep = self.replicas[idx]
+                if not rep.retired:
+                    if rep.engine.has_work():
+                        # a fresh recovery fleet is dry; a LIVE router
+                        # asked to re-reconcile mid-traffic must not
+                        # cancel residents — leave it to scale_in
+                        continue
+                    rep.retire()
 
     def _materialize_entry(self, ent, now_wall: float) -> bool:
         """Materialize ONE journal entry into the router's request table
@@ -728,7 +794,9 @@ class ServingRouter:
 
     def revive_replica(self, idx: int) -> None:
         rep = self.replicas[idx]
-        if rep.alive:
+        if rep.alive or rep.retired:
+            # a retired slot is a JOURNALED membership decision — only a
+            # journaled scale-out reopens it, never the supervisor path
             return
         rep.revive()
         self.metrics.replica_revives += 1
@@ -747,6 +815,165 @@ class ServingRouter:
     def undrain_replica(self, idx: int) -> None:
         self.replicas[idx].end_drain()
 
+    # -- elastic membership (the autoscaler's scale-out/in ladders) ----
+    #
+    # Every transition is WRITE-AHEAD journaled: intent before any state
+    # changes, done after the transition completed, abort when it was
+    # interrupted (kill mid-drain, crash mid-scale). begin/commit/
+    # abort_scale are the ONLY callers of journal.append_scale — the
+    # dslint seam rule enforces it, the same law as the terminal funnel.
+
+    def begin_scale(self, op: str, idx: int, reason: str) -> None:
+        if self.journal is not None:
+            self.journal.append_scale(op, idx, "intent", reason=reason)
+
+    def commit_scale(self, op: str, idx: int, reason: str = "") -> None:
+        if self.journal is not None:
+            self.journal.append_scale(op, idx, "done", reason=reason)
+
+    def abort_scale(self, op: str, idx: int, reason: str = "") -> None:
+        if self.journal is not None:
+            self.journal.append_scale(op, idx, "abort", reason=reason)
+
+    def add_replica(self) -> int:
+        """Append ONE fresh replica slot via :attr:`replica_factory`
+        (raises without one). The new replica starts ACTIVE — callers
+        wanting a parked slot retire it. No journaling here: this is the
+        mechanism; :meth:`scale_out` / recovery own the record."""
+        if self.replica_factory is None:
+            raise RuntimeError(
+                "add_replica needs replica_factory (init_fleet sets it; "
+                "a hand-built router must provide its own)")
+        eng = self.replica_factory()
+        if eng.config.block_size != \
+                self.replicas[0].engine.config.block_size:
+            raise ValueError("replica_factory produced a mismatched "
+                             "block_size; the affinity probe and KV "
+                             "transfer both require one page geometry")
+        idx = len(self.replicas)
+        self.replicas.append(Replica(idx, eng))
+        return idx
+
+    def scale_out(self, reason: str = "autoscale",
+                  warm_chains: int = 8) -> int:
+        """Grow the fleet by one replica — reusing the lowest retired
+        slot when one exists (its resident compile survives in-process;
+        reactivation is why no scale event ever pays a recompile),
+        spawning through :attr:`replica_factory` otherwise — then
+        pre-warm its prefix cache from the fleet's hottest chains
+        (:meth:`warm_replica`). Journaled intent -> activate -> warm ->
+        done; a crash anywhere inside recovers to NO ghost replica
+        (recovery aborts the unfinished intent). Returns the replica
+        index scaled out."""
+        idx = next((r.idx for r in self.replicas if r.retired), None)
+        fresh = idx is None
+        if fresh:
+            if self.replica_factory is None:
+                raise RuntimeError(
+                    "scale_out: no retired slot to reuse and no "
+                    "replica_factory to spawn one")
+            idx = len(self.replicas)
+        self.begin_scale("out", idx, reason)
+        try:
+            if fresh:
+                self.add_replica()
+            rep = self.replicas[idx]
+            rep.activate()
+            self.warm_replica(idx, top_k=warm_chains)
+        except BaseException:
+            self.abort_scale("out", idx, "error")
+            self.metrics.scale_aborts += 1
+            raise
+        self.commit_scale("out", idx, reason)
+        self.metrics.scale_outs += 1
+        log_dist(f"fleet: scaled out {rep.name} "
+                 f"({'fresh' if fresh else 'reactivated'}, {reason})",
+                 ranks=[0])
+        return idx
+
+    def scale_in(self, idx: int, reason: str = "autoscale") -> bool:
+        """Begin removing one replica: journal the intent, then compose
+        the existing drain ladder — its queued work re-enters the fleet
+        (requeued, never dropped), its residents run dry in the normal
+        step loop, and :meth:`step` retires the slot (pages returned,
+        caches dropped, admission closed) once dry, journaling the done.
+        A kill mid-drain aborts the transition instead (the kill/revive
+        path owns the replica from there). Returns False without acting
+        when the replica is not scalable-in (already retired/dead/
+        pending, or it is the last active replica)."""
+        rep = self.replicas[idx]
+        active = [r for r in self.replicas
+                  if r.alive and not r.retired]
+        if (rep.retired or not rep.alive or idx in self._pending_scale_in
+                or len(active) <= 1):
+            return False
+        self.begin_scale("in", idx, reason)
+        self._pending_scale_in[idx] = reason
+        shed = self.drain_replica(idx)
+        log_dist(f"fleet: scale-in of {rep.name} begun "
+                 f"({shed} shed, {reason}); draining dry", ranks=[0])
+        return True
+
+    def _complete_pending_scale_ins(self) -> None:
+        """Advance every in-flight scale-in one tick: retire replicas
+        whose drain ran dry (journal done), abort transitions a kill
+        interrupted (the drain intent died with the process — auto-
+        revive must bring the replica back ROUTABLE, not half-retired)."""
+        for idx, reason in list(self._pending_scale_in.items()):
+            rep = self.replicas[idx]
+            if not rep.alive or not rep.draining:
+                # killed (or externally undrained) mid-drain: the
+                # ladder is off — journal the abort so recovery never
+                # half-retires this slot
+                del self._pending_scale_in[idx]
+                self.abort_scale("in", idx, "interrupted")
+                self.metrics.scale_aborts += 1
+                log_dist(f"fleet: scale-in of {rep.name} aborted "
+                         f"(interrupted mid-drain)", ranks=[0])
+                continue
+            if rep.engine.has_work():
+                continue
+            del self._pending_scale_in[idx]
+            rep.retire()
+            self.commit_scale("in", idx, reason)
+            self.metrics.scale_ins += 1
+            log_dist(f"fleet: {rep.name} retired (scale-in complete, "
+                     f"{reason})", ranks=[0])
+
+    def warm_replica(self, idx: int, top_k: int = 8) -> Tuple[int, int]:
+        """Deliberate scale-out warmup: pre-transfer the fleet's ``top_k``
+        hottest prefix chains (the affinity dispatch record) onto replica
+        ``idx`` from whichever live peer holds each — device pages via
+        ``transfer_prefix_kv``, host-tier pages via
+        ``transfer_host_prefix_kv``. The router's fewest-ever-routed
+        tiebreak then finishes the slow-start with real traffic. Returns
+        (device_pages, host_pages) moved; (0, 0) when nothing is hot or
+        no peer can source (the new replica simply computes — correct,
+        just colder)."""
+        from .fleet import chain_tokens, warm_prefix_kv
+
+        rep = self.replicas[idx]
+        hot = sorted(self._chain_heat.items(), key=lambda kv: -kv[1])
+        dev_total = host_total = 0
+        for key, _ in hot[:top_k]:
+            tokens = chain_tokens(key)
+            for donor in self.replicas:
+                if donor is rep or not donor.alive or donor.retired:
+                    continue
+                dev, host = warm_prefix_kv(donor.engine, rep.engine,
+                                           tokens)
+                dev_total += dev
+                host_total += host
+                if dev or host:
+                    break  # this chain is warmed; next chain
+        self.metrics.scale_warm_pages += dev_total
+        self.metrics.scale_warm_pages_host += host_total
+        if dev_total or host_total:
+            log_dist(f"fleet: warmed {rep.name} with {dev_total} device "
+                     f"+ {host_total} host-tier page(s) of hot prefix",
+                     ranks=[0])
+        return dev_total, host_total
+
     def rolling_restart(self, capacity_floor: Optional[int] = None,
                         max_steps_per_replica: int = 2000
                         ) -> Dict[str, Any]:
@@ -762,7 +989,13 @@ class ServingRouter:
         Raises RuntimeError when a replica cannot drain (or the floor
         cannot be met) within ``max_steps_per_replica`` fleet ticks —
         a stuck rolling restart must fail loudly, not spin."""
-        n = len(self.replicas)
+        # retired slots are OUT of the fleet by journaled decision: they
+        # are neither restarted nor counted against the capacity floor
+        members = [r for r in self.replicas if not r.retired]
+        n = len(members)
+        if n == 0:
+            raise RuntimeError("rolling restart: every replica is "
+                               "retired; scale out first")
         floor = n - 1 if capacity_floor is None else int(capacity_floor)
         if not 0 <= floor <= n - 1:
             raise ValueError(
@@ -770,7 +1003,7 @@ class ServingRouter:
                 f"must be restartable), got {floor}")
         restarted: List[str] = []
         shed_total = 0
-        for rep in self.replicas:
+        for rep in members:
             steps = 0
             # the capacity floor gates the takedown, not the drain: wait
             # out delayed auto-revives before touching the next replica
@@ -819,6 +1052,7 @@ class ServingRouter:
                 rep.engine.step()
             rep.note_progress()
         self._collect()
+        self._complete_pending_scale_ins()
         self._check_total_outage()
         self._step_no += 1
         if self.journal is not None and self.cfg.journal_compact_every \
@@ -831,6 +1065,9 @@ class ServingRouter:
         m.steps += 1
         m.queue_depth = len(self.queue)
         m.in_flight = len(self._placements)
+        m.replicas_total = len(self.replicas)
+        m.replicas_active = sum(1 for r in self.replicas
+                                if r.alive and not r.retired)
 
     def _check_total_outage(self) -> None:
         """Bound the whole-fleet-dead livelock: with work queued, nothing
@@ -1042,6 +1279,17 @@ class ServingRouter:
             self._placements[freq.fid] = (rep.idx, rid)
             routed = self.routed_by_replica  # one field read (RMW below)
             routed[rep.idx] = routed.get(rep.idx, 0) + 1
+            if freq.route_hashes:
+                # hot-chain record for the scale-out warmup: the DEEPEST
+                # chain key names the whole prefix, so one entry per
+                # dispatched prompt, LRU-bounded (heat decays by falling
+                # off the cold end, not by clock — deterministic)
+                heat = self._chain_heat
+                key = freq.route_hashes[-1]
+                heat[key] = heat.get(key, 0) + 1
+                heat.move_to_end(key)
+                while len(heat) > self._chain_heat_cap:
+                    heat.popitem(last=False)
             if pfx > 0:
                 self.metrics.routed_affinity += 1
             else:
@@ -1218,6 +1466,12 @@ class ServingRouter:
             "queue_depth": len(self.queue),
             "in_flight": len(self._placements),
             "draining": self._draining,
+            "replicas_total": len(self.replicas),
+            "replicas_active": sum(1 for r in self.replicas
+                                   if r.alive and not r.retired),
+            "replicas_retired": sum(1 for r in self.replicas
+                                    if r.retired),
+            "scale_in_pending": sorted(self._pending_scale_in),
             "fleet_goodput_tokens_per_sec": round(goodput, 2),
             "routed_by_replica": {self.replicas[i].name: n
                                   for i, n in
@@ -1252,4 +1506,12 @@ def init_fleet(engine, n_replicas: int, serving_config=None,
                              serving_configs[i] if serving_configs
                              else serving_config)
                for i in range(n_replicas)]
-    return ServingRouter(engines, config=router_config)
+    router = ServingRouter(engines, config=router_config)
+    # elastic scale-out beyond the constructed fleet spawns through this
+    # (new replicas take the LAST config — the decode shape on a
+    # disaggregated fleet, the uniform one otherwise); each fresh
+    # ServingEngine compiles its OWN resident program once, so the
+    # one-compile-per-replica invariant holds across scale events
+    spawn_cfg = serving_configs[-1] if serving_configs else serving_config
+    router.replica_factory = lambda: ServingEngine(engine, spawn_cfg)
+    return router

@@ -543,11 +543,18 @@ def fleet_metrics_text(router) -> str:
     the whole fleet, and a per-replica dashboard is one label filter."""
     scalars: Dict[str, float] = {
         f"fleet_{k}": v for k, v in router.metrics.snapshot().items()}
+    autoscaler = getattr(router, "autoscaler", None)
+    if autoscaler is not None:
+        # the decision layer's own series (the scale TRANSITIONS are in
+        # ds_fleet_scale_*; these are what the policy saw and chose)
+        scalars.update({f"autoscale_{k}": v for k, v
+                        in autoscaler.metrics.snapshot().items()})
     for rep in router.replicas:
         lbl = f"{{replica={rep.name}}}"
         scalars[f"replica_alive{lbl}"] = float(rep.alive)
         scalars[f"replica_ejected{lbl}"] = float(rep.ejected)
         scalars[f"replica_draining{lbl}"] = float(rep.draining)
+        scalars[f"replica_retired{lbl}"] = float(rep.retired)
         scalars[f"replica_prefix_index_blocks{lbl}"] = float(
             rep.prefix_index_blocks())
         for k, v in rep.engine.metrics.snapshot().items():
@@ -610,6 +617,27 @@ def fleet_statusz(router) -> str:
                  f"{int(c['replica_revives'])} revives, "
                  f"{int(c['ejections'])} ejections, "
                  f"{int(c['readmissions'])} readmissions")
+    if c.get("scale_outs") or c.get("scale_ins") or c.get("scale_aborts") \
+            or st.get("replicas_retired"):
+        lines.append(f"elastic: {st['replicas_active']} active of "
+                     f"{st['replicas_total']} slots "
+                     f"({st['replicas_retired']} retired); "
+                     f"{int(c['scale_outs'])} scale-outs, "
+                     f"{int(c['scale_ins'])} scale-ins, "
+                     f"{int(c['scale_aborts'])} aborts, "
+                     f"{int(c['scale_warm_pages'])}+"
+                     f"{int(c['scale_warm_pages_host'])} pages warmed "
+                     f"(device+host)")
+    autoscaler = getattr(router, "autoscaler", None)
+    if autoscaler is not None:
+        a = autoscaler.status()
+        lines.append(f"autoscaler: {a['policy']}, bounds "
+                     f"{a['bounds'][0]}..{a['bounds'][1]}, "
+                     f"cooldown {a['cooldown_remaining']}/"
+                     f"{a['cooldown_steps']} left, "
+                     f"{int(a['counters']['scale_out_decisions'])} out / "
+                     f"{int(a['counters']['scale_in_decisions'])} in "
+                     f"decisions")
     if st["disaggregated"]:
         lines.append(f"disaggregation: {int(c['disagg_hops'])} hops, "
                      f"{int(c['kv_pages_transferred'])} KV pages "

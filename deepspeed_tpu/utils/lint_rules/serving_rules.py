@@ -57,6 +57,12 @@ _ACQUIRE_METHODS = {"allocate", "acquire", "cow"}
 _JOURNAL_APPEND_METHODS = {"append_admit", "append_deliver",
                            "append_terminal"}
 _JOURNAL_ALLOW_FUNCS = {"submit", "_deliver", "_fleet_release"}
+#: the fleet-membership WAL has its own seam: scale records append only
+#: from the router's begin/commit/abort trio (intent before any state
+#: changes, done after the transition, abort when interrupted) — an
+#: append_scale anywhere else changes what membership a crash recovers
+_SCALE_APPEND_METHODS = {"append_scale"}
+_SCALE_ALLOW_FUNCS = {"begin_scale", "commit_scale", "abort_scale"}
 
 
 def _dotted(node: ast.AST) -> str:
@@ -220,16 +226,19 @@ def _check_journal_writes(ctx: FileCtx) -> List[Finding]:
     for node in ast.walk(ctx.tree):
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _JOURNAL_APPEND_METHODS):
+                and node.func.attr in (_JOURNAL_APPEND_METHODS
+                                       | _SCALE_APPEND_METHODS)):
             continue
         fname = _enclosing_func_name(ctx, node)
-        if fname in _JOURNAL_ALLOW_FUNCS:
+        scale = node.func.attr in _SCALE_APPEND_METHODS
+        allow = _SCALE_ALLOW_FUNCS if scale else _JOURNAL_ALLOW_FUNCS
+        if fname in allow:
             continue
         out.append(ctx.finding(
             node, "journal-write",
             f"journal {node.func.attr}() in {fname or 'module'} — "
             f"appends must ride the router's write-ahead seam "
-            f"({'/'.join(sorted(_JOURNAL_ALLOW_FUNCS))}) so the "
+            f"({'/'.join(sorted(allow))}) so the "
             f"crash-recovery ordering contract holds"))
     return out
 

@@ -1,0 +1,360 @@
+"""Elastic autoscaling drills: the policy loop and the scale ladders.
+
+Two layers under test:
+
+- the **policy** (``Autoscaler``): hysteresis bands, patience counters,
+  cooldown, one-transition-at-a-time, and the min/max bounds — driven
+  with synthetic signals so each property is exercised in isolation;
+- the **ladders** (``ServingRouter.scale_out`` / ``scale_in``): the
+  drain -> run-dry -> retire composition under its edge cases — drain
+  with journal-inflight requests (requeued, never dropped), drain raced
+  by a kill (journaled abort, replica back routable), retirement of the
+  affinity-hottest replica (its chains re-warm onto the reused slot from
+  the surviving peer), and crash recovery of every journaled membership
+  state (torn intent aborts to no ghost replica; done-out re-spawns;
+  done-in re-retires). Slot reuse must never pay a recompile.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import (Autoscaler, AutoscalerConfig,
+                                             RouterConfig, ServingConfig,
+                                             init_fleet, replay_scale_state)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+MAX_STEPS = 600
+
+VOCAB = None  # set by the engine fixture
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    global VOCAB
+    cfg = LlamaConfig.tiny(remat=False)
+    VOCAB = cfg.vocab_size
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    return ds.init_inference(model, params=params, dtype="fp32")
+
+
+def fleet(engine, n=2, journal_dir=None, **rcfg_kw):
+    rcfg = RouterConfig(journal_dir=journal_dir, **rcfg_kw) \
+        if (journal_dir or rcfg_kw) else None
+    return init_fleet(
+        engine, n,
+        serving_config=ServingConfig(max_batch_size=2, block_size=8,
+                                     num_blocks=48, max_model_len=96,
+                                     prefix_cache=True),
+        router_config=rcfg)
+
+
+def fake_signals(router, queue=0.0, burn=0.0, occ=0.0):
+    """Synthetic decision inputs with LIVE membership counts, so the
+    policy's bounds checks track the transitions it causes."""
+    def _signals():
+        active = [r for r in router.replicas
+                  if r.alive and not r.retired]
+        return {"active": float(len(active)),
+                "total": float(len(router.replicas)),
+                "queue_per_replica": queue,
+                "mean_burn_rate": burn,
+                "mean_occupancy": occ,
+                "fleet_goodput_tokens_per_sec": 0.0}
+    return _signals
+
+
+def n_active(router):
+    return sum(1 for r in router.replicas if r.alive and not r.retired)
+
+
+def settle_scale_ins(router):
+    for _ in range(50):
+        if not router._pending_scale_in:
+            return
+        router.step()
+    raise AssertionError("scale-in never settled")
+
+
+# ---------------------------------------------------------------------------
+# the policy loop
+# ---------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(queue_low=5.0, queue_high=1.0).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(out_patience=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(cooldown_steps=-1).validate()
+    AutoscalerConfig().validate()
+
+
+def test_pressure_patience_cooldown_and_max_bound(engine):
+    """Scale-out waits out its patience, holds through the cooldown
+    (patience running underneath), and stops at max_replicas."""
+    router = fleet(engine, 1)
+    asc = Autoscaler(router, AutoscalerConfig(
+        min_replicas=1, max_replicas=3,
+        out_patience=3, in_patience=5, cooldown_steps=4))
+    assert router.autoscaler is asc  # the export surface's discovery
+    asc.signals = fake_signals(router, queue=10.0)  # sustained pressure
+
+    assert [asc.tick() for _ in range(3)] == [None, None, "scale_out"]
+    assert n_active(router) == 2
+    # cooldown holds even under pressure; the patience counter keeps
+    # running underneath, so the first post-cooldown tick acts
+    held = [asc.tick() for _ in range(4)]
+    assert held == [None] * 4
+    assert asc.metrics.holds_cooldown >= 1
+    assert asc.tick() == "scale_out"
+    assert n_active(router) == 3
+    # at the max bound: pressure can push all it wants
+    for _ in range(asc.cfg.cooldown_steps + 3):
+        assert asc.tick() is None
+    assert n_active(router) == 3
+    assert asc.metrics.holds_bounds >= 1
+    assert asc.metrics.scale_out_decisions == 2
+
+
+def test_idle_patience_scale_in_and_min_bound(engine):
+    """Scale-in needs the longer idle patience, completes through the
+    router's step loop (one transition at a time), and never shrinks
+    under min_replicas."""
+    router = fleet(engine, 2)
+    asc = Autoscaler(router, AutoscalerConfig(
+        min_replicas=1, max_replicas=3,
+        out_patience=2, in_patience=4, cooldown_steps=3))
+    asc.signals = fake_signals(router)  # everything at zero: idle
+
+    assert [asc.tick() for _ in range(4)] == [None, None, None, "scale_in"]
+    # mid-drain the policy only observes
+    assert router._pending_scale_in
+    assert asc.tick() is None
+    assert asc.metrics.holds_pending >= 1
+    settle_scale_ins(router)
+    assert n_active(router) == 1
+    assert router.replicas[1].retired
+    # idle forever at the min bound: held, never scaled to nothing
+    for _ in range(asc.cfg.cooldown_steps + asc.cfg.in_patience + 3):
+        asc.tick()
+    assert n_active(router) == 1
+    assert asc.metrics.holds_bounds >= 1
+
+
+def test_hysteresis_dead_zone_never_acts(engine):
+    """Signals between the bands (above low, below high) reset BOTH
+    patience counters — flapping traffic lives there without moving
+    the fleet."""
+    router = fleet(engine, 2)
+    asc = Autoscaler(router, AutoscalerConfig(
+        queue_low=0.5, queue_high=3.0,
+        out_patience=1, in_patience=1, cooldown_steps=0))
+    asc.signals = fake_signals(router, queue=1.5)  # inside the gap
+    for _ in range(10):
+        assert asc.tick() is None
+    assert n_active(router) == 2
+    assert asc.metrics.pressure_ticks == 0
+    assert asc.metrics.idle_ticks == 0
+
+
+# ---------------------------------------------------------------------------
+# the scale ladders (drain -> run dry -> retire) and their edge cases
+# ---------------------------------------------------------------------------
+
+def test_scale_in_with_journal_inflight_requeues_everything(engine,
+                                                            tmp_path):
+    """Scale-in of a replica holding journal-tracked in-flight work:
+    every request finishes (requeued, never dropped), the slot retires
+    once dry, and the journal's scale fold says so."""
+    jdir = str(tmp_path / "j")
+    router = fleet(engine, 2, journal_dir=jdir)
+    rs = np.random.RandomState(3)
+    fids = [router.submit(rs.randint(1, VOCAB, 12), max_new_tokens=6)
+            for _ in range(6)]
+    for _ in range(2):  # work lands on both replicas
+        router.step()
+    victim = next(r.idx for r in router.replicas
+                  if r.engine.has_work())
+    assert router.scale_in(victim, reason="test")
+    outs = router.run(max_steps=MAX_STEPS)
+    settle_scale_ins(router)
+    assert all(outs[f].state == "finished" for f in fids)
+    assert router.replicas[victim].retired
+    assert router.metrics.scale_ins == 1
+    for rep in router.replicas:
+        assert rep.engine.block_pool.used_count == 0, rep.name
+    router.journal.flush()
+    st = replay_scale_state(jdir)[victim]
+    assert st["pending"] is None and st["active"] is False
+
+
+def test_kill_racing_drain_aborts_scale_in(engine, tmp_path):
+    """A kill mid-drain takes the ladder off: the transition journals an
+    ABORT (recovery never half-retires the slot) and the auto-revived
+    replica comes back routable."""
+    jdir = str(tmp_path / "j")
+    router = fleet(engine, 2, journal_dir=jdir, revive_after_steps=3)
+    rs = np.random.RandomState(4)
+    fids = [router.submit(rs.randint(1, VOCAB, 12), max_new_tokens=6)
+            for _ in range(4)]
+    router.step()
+    victim = next((r.idx for r in router.replicas
+                   if r.engine.has_work()), 0)
+    assert router.scale_in(victim, reason="test")
+    router.kill_replica(victim, reason="race")
+    outs = router.run(max_steps=MAX_STEPS)
+    assert all(outs[f].state == "finished" for f in fids)
+    assert not router._pending_scale_in
+    assert router.metrics.scale_aborts == 1
+    assert router.metrics.scale_ins == 0
+    rep = router.replicas[victim]
+    assert not rep.retired
+    assert rep.alive and rep.routable  # auto-revived, back in the fleet
+    router.journal.flush()
+    st = replay_scale_state(jdir)[victim]
+    assert st["pending"] is None and st["active"] is None
+
+
+def test_retire_hottest_replica_rewarms_reused_slot_from_peer(engine):
+    """Scale-in of the affinity-hottest replica, then scale-out reusing
+    its slot: the hot chains (now living on the surviving peer that
+    absorbed the traffic) pre-warm back onto the reactivated slot."""
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(5)
+    prefix = rs.randint(1, VOCAB, 24)
+
+    def serve():
+        fid = router.submit(
+            np.concatenate([prefix, rs.randint(1, VOCAB, 4)]),
+            max_new_tokens=4)
+        outs = router.run(max_steps=MAX_STEPS)
+        assert outs[fid].state == "finished"
+        return outs[fid].served_on[0]
+
+    home = serve()
+    for _ in range(2):
+        assert serve() == home  # affinity home established and hot
+    hot_idx = int(home)
+    assert router.scale_in(hot_idx, reason="test")
+    settle_scale_ins(router)
+    assert router.replicas[hot_idx].retired
+    for _ in range(2):
+        serve()  # the peer absorbs the tenant and warms its own index
+    assert router.scale_out(reason="test") == hot_idx  # slot reuse
+    assert router.metrics.scale_warm_pages > 0
+    assert router.replicas[hot_idx].prefix_index_blocks() > 0
+    # and the re-warmed KV is real: the reactivated slot can serve the
+    # tenant from cache (prefix hits, not recompute-from-cold)
+    eng = router.replicas[hot_idx].engine
+    before = eng.metrics.prefix_hits
+    rid = eng.submit(np.concatenate([prefix, rs.randint(1, VOCAB, 4)]),
+                     max_new_tokens=2)
+    eng.run(max_steps=MAX_STEPS)
+    assert eng.metrics.prefix_hits > before
+    assert eng.poll(rid).state == "finished"
+
+
+def test_crash_mid_scale_out_recovers_with_no_ghost_replica(engine,
+                                                            tmp_path):
+    """kill -9 between the scale-out intent and the act: recovery aborts
+    the torn transition — the fleet comes back at its base membership,
+    no ghost slot."""
+    jdir = str(tmp_path / "j")
+    router = fleet(engine, 2, journal_dir=jdir)
+    router.begin_scale("out", 2, "torn")
+    router.journal.close()  # the crash: the spawn never happened
+
+    router = fleet(engine, 2, journal_dir=jdir)
+    router.recover()
+    assert len(router.replicas) == 2
+    assert n_active(router) == 2
+    assert router.metrics.scale_aborts == 1
+    router.journal.flush()
+    st = replay_scale_state(jdir)[2]
+    assert st["pending"] is None and st["active"] is None
+
+
+def test_recovery_replays_done_transitions(engine, tmp_path):
+    """Journaled DONE governs across a crash: a completed scale-out
+    beyond the base fleet is re-spawned active, a completed scale-in is
+    re-retired — the recovered membership matches the journal exactly."""
+    jdir = str(tmp_path / "j")
+    router = fleet(engine, 2, journal_dir=jdir)
+    assert router.scale_out(reason="grow") == 2
+    assert router.scale_in(1, reason="shrink")
+    settle_scale_ins(router)
+    assert router.replicas[1].retired
+    router.journal.close()  # crash with out(2) and in(1) both DONE
+
+    router = fleet(engine, 2, journal_dir=jdir)
+    router.recover()
+    assert len(router.replicas) == 3  # idx 2 re-spawned
+    assert not router.replicas[0].retired and router.replicas[0].alive
+    assert router.replicas[1].retired  # re-retired
+    assert not router.replicas[2].retired and router.replicas[2].alive
+    # the reconciled fleet serves
+    fid = router.submit([3, 5, 7], max_new_tokens=2)
+    outs = router.run(max_steps=MAX_STEPS)
+    assert outs[fid].state == "finished"
+    for rep in router.replicas:
+        assert rep.engine.block_pool.used_count == 0, rep.name
+
+
+def test_slot_reuse_never_recompiles(engine):
+    """Retire-then-reactivate keeps the slot's resident compile: a full
+    scale-in/scale-out cycle with traffic on both sides leaves exactly
+    one mixed_step compile and a silent recompile sentinel."""
+    router = fleet(engine, 2)
+    rs = np.random.RandomState(6)
+
+    def wave():
+        fids = [router.submit(rs.randint(1, VOCAB, 10), max_new_tokens=4)
+                for _ in range(4)]
+        outs = router.run(max_steps=MAX_STEPS)
+        assert all(outs[f].state == "finished" for f in fids)
+
+    wave()  # both replicas compile their resident step
+    assert router.scale_in(1, reason="cycle")
+    settle_scale_ins(router)
+    assert router.replicas[1].retired
+    assert router.scale_out(reason="cycle") == 1
+    wave()
+    rep = router.replicas[1]
+    assert rep.engine.compile_counts == {"mixed_step": 1}, \
+        rep.engine.compile_counts
+    assert rep.engine.perf.recompile_total == 0
+    router.check_consistent()
+
+
+def test_autoscaler_metrics_exported(engine):
+    """The decision layer's series ride the fleet /metrics scrape as
+    ``ds_autoscale_*`` and the /statusz block names the policy."""
+    from deepspeed_tpu.monitor.export import (fleet_metrics_text,
+                                              fleet_statusz)
+
+    router = fleet(engine, 1)
+    asc = Autoscaler(router, AutoscalerConfig(max_replicas=2,
+                                              out_patience=1,
+                                              cooldown_steps=0))
+    asc.signals = fake_signals(router, queue=10.0)
+    assert asc.tick() == "scale_out"
+    text = fleet_metrics_text(router)
+    assert "ds_autoscale_ticks 1" in text
+    assert "ds_autoscale_scale_out_decisions 1" in text
+    assert "ds_fleet_scale_outs 1" in text
+    statusz = fleet_statusz(router)
+    assert "autoscaler: hysteresis+cooldown" in statusz
+    assert "1 out / 0 in decisions" in statusz

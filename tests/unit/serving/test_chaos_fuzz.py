@@ -48,10 +48,12 @@ def test_fuzz_schedule_vocabulary_well_formed():
 
     rng = random.Random(0)
     for _ in range(64):
-        specs, _ = chaos_fuzz.draw_schedule(rng, 3, 40)
+        specs, _, scale_events = chaos_fuzz.draw_schedule(rng, 3, 40)
         for spec in specs:
             parsed = fault_injection.parse_faults(spec)
             assert len(parsed) == 1 and parsed[0].name
+        for _, kind in scale_events:
+            assert kind in chaos_fuzz._SCALE_EVENTS
 
 
 @pytest.mark.slow

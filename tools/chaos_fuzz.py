@@ -25,6 +25,13 @@ engines are rebuilt cold) and drives a FRESH fleet through
 must hold across the crash, which is exactly the claim the journal
 exists to make.
 
+Schedules also draw 0-2 SCALE events (``scale_out`` / ``scale_in`` /
+``kill_during_scale`` / ``crash_mid_scale_out``): elastic membership
+changes injected mid-traffic, including kill -9 between a scale-out
+intent and the act and a kill racing a drain. A fifth invariant then
+holds per episode: the journal's scale fold matches the live fleet —
+no transition left open, no ghost replicas, no half-retired slots.
+
 Usage::
 
   python tools/chaos_fuzz.py --episodes 50 --seed 7     # the slow bar
@@ -61,9 +68,24 @@ _FAULTS = (
 )
 
 
+#: fuzzer-executed scale episode vocabulary (like the router crash,
+#: these are driven by the fuzzer itself, not DS_FAULT): elastic
+#: membership changes injected mid-traffic —
+#: ``scale_out`` grows the fleet (warmup included), ``scale_in`` begins
+#: the drain->run-dry->retire ladder on a random active replica,
+#: ``kill_during_scale`` races that drain with an immediate kill (the
+#: transition must ABORT, never half-retire), and
+#: ``crash_mid_scale_out`` writes a scale-out INTENT and then kills the
+#: router process before the transition acts (kill -9 mid-scale-out:
+#: recovery must abort it and admit no ghost replica)
+_SCALE_EVENTS = ("scale_out", "scale_in", "kill_during_scale",
+                 "crash_mid_scale_out")
+
+
 def draw_schedule(rng: random.Random, n_replicas: int, horizon: int):
-    """One episode's fault schedule: 1-3 DS_FAULT specs plus maybe a
-    router-crash step (executed by the fuzzer, not the env var)."""
+    """One episode's fault schedule: 1-3 DS_FAULT specs, maybe a
+    router-crash step, and 0-2 scale events (executed by the fuzzer,
+    not the env var)."""
     specs = []
     for _ in range(rng.randint(1, 3)):
         t = rng.choice(_FAULTS)
@@ -72,7 +94,15 @@ def draw_schedule(rng: random.Random, n_replicas: int, horizon: int):
                               fails=rng.randint(1, 2)))
     crash_step = rng.randint(3, max(4, horizon)) \
         if rng.random() < 0.4 else None
-    return specs, crash_step
+    scale_events = []
+    if rng.random() < 0.6:
+        # early half of the horizon: episodes drain in well under the
+        # full horizon, and an event past convergence never fires
+        for _ in range(rng.randint(1, 2)):
+            scale_events.append((rng.randint(1, max(2, horizon // 2)),
+                                 rng.choice(_SCALE_EVENTS)))
+        scale_events.sort()
+    return specs, crash_step, scale_events
 
 
 class InvariantViolation(AssertionError):
@@ -97,7 +127,8 @@ def run_episode(engine, vocab, ep: int, seed: int, n_replicas: int,
 
     rng = random.Random(f"{seed}/{ep}")
     horizon = 4 * n_requests
-    specs, crash_step = draw_schedule(rng, n_replicas, horizon)
+    specs, crash_step, scale_events = draw_schedule(rng, n_replicas,
+                                                    horizon)
     jdir = os.path.join(journal_root, f"ep{ep:04d}")
 
     def build():
@@ -123,6 +154,71 @@ def run_episode(engine, vocab, ep: int, seed: int, n_replicas: int,
     crashed = False
     try:
         router = build()
+
+        # reporting counters survive crashes here even though the live
+        # FleetMetrics die with the router (a real deployment's scrape
+        # history survives its serving process the same way) — without
+        # this, an episode whose scale events all precede its crash
+        # reports zero scaling it actually executed
+        carried = {"requeued": 0, "recovered": 0, "kills": 0,
+                   "scale_outs": 0, "scale_ins": 0, "scale_aborts": 0}
+
+        def do_crash():
+            # router-process death, in-process: abandon the router
+            # and every replica engine (a real crash loses exactly
+            # this state — the journal is all that survives), then
+            # recover a COLD fleet from the journal directory
+            nonlocal router, crashed
+            crashed = True
+            m = router.metrics
+            carried["requeued"] += m.requests_requeued
+            carried["recovered"] += m.requests_recovered
+            carried["kills"] += m.replica_kills
+            carried["scale_outs"] += m.scale_outs
+            carried["scale_ins"] += m.scale_ins
+            carried["scale_aborts"] += m.scale_aborts
+            router.journal.close()
+            router = None
+            fault_injection.reset()  # fresh process, fresh streams
+            router = build()
+            recovered = router.recover()
+            # every fid not yet terminal on disk must come back
+            live_on_disk = {e.fid for e
+                            in replay_journal(jdir).values()
+                            if not e.done}
+            _check(set(recovered) == live_on_disk,
+                   "recovery missed journaled live requests",
+                   (sorted(recovered), sorted(live_on_disk)))
+
+        def do_scale(kind):
+            if kind == "scale_out":
+                active = sum(1 for r in router.replicas
+                             if r.alive and not r.retired)
+                if active < n_replicas + 2:  # bound fleet growth
+                    router.scale_out(reason="chaos")
+                return
+            if kind == "crash_mid_scale_out":
+                # kill -9 between the scale-out INTENT and the act:
+                # recovery must abort the transition and admit no
+                # ghost replica (the engine never even spawned)
+                idx = next((r.idx for r in router.replicas
+                            if r.retired), len(router.replicas))
+                router.begin_scale("out", idx, "chaos_torn")
+                do_crash()
+                return
+            # scale_in / kill_during_scale: the drain->run-dry->retire
+            # ladder, maybe raced by an immediate kill (the abort path)
+            cands = [r.idx for r in router.replicas
+                     if r.alive and not r.retired
+                     and r.idx not in router._pending_scale_in]
+            if len(cands) <= 1:
+                return
+            victim = rng.choice(cands)
+            if router.scale_in(victim, reason="chaos") and \
+                    kind == "kill_during_scale":
+                router.kill_replica(victim, reason="kill_during_scale")
+
+        remaining_scales = list(scale_events)
         fids = []
         i = 0
         steps = 0
@@ -130,37 +226,33 @@ def run_episode(engine, vocab, ep: int, seed: int, n_replicas: int,
             while i < len(prompts) and len(router.queue) < 3:
                 fids.append(router.submit(prompts[i], max_new_tokens=6))
                 i += 1
+            while remaining_scales and remaining_scales[0][0] <= steps:
+                do_scale(remaining_scales.pop(0)[1])
             if crash_step is not None and steps == crash_step \
                     and not crashed:
-                # router-process death, in-process: abandon the router
-                # and every replica engine (a real crash loses exactly
-                # this state — the journal is all that survives), then
-                # recover a COLD fleet from the journal directory
-                crashed = True
-                router.journal.close()
-                del router
-                fault_injection.reset()  # fresh process, fresh streams
-                router = build()
-                recovered = router.recover()
-                # every fid not yet terminal on disk must come back
-                live_on_disk = {e.fid for e
-                                in replay_journal(jdir).values()
-                                if not e.done}
-                _check(set(recovered) == live_on_disk,
-                       "recovery missed journaled live requests",
-                       (sorted(recovered), sorted(live_on_disk)))
+                do_crash()
             if router.has_work():
                 router.step()
             steps += 1
             _check(steps < 120 * n_requests, "episode wedged (no "
                    "terminal convergence)", {"steps": steps})
+        # let any still-pending scale-in retire (its drain already ran
+        # dry with the traffic; only the bookkeeping tick is left)
+        settle = 0
+        while router._pending_scale_in:
+            router.step()
+            settle += 1
+            _check(settle < 50, "scale-in never settled",
+                   sorted(router._pending_scale_in))
         # revive everything for the invariant sweep (a dead replica's
-        # pool must ALSO be clean — kill returns pages like the OS does)
-        for idx in range(n_replicas):
-            router.revive_replica(idx)
+        # pool must ALSO be clean — kill returns pages like the OS
+        # does; retired slots refuse the revive and stay out)
+        for rep in router.replicas:
+            router.revive_replica(rep.idx)
         outs = {f: router.poll(f) for f in fids}
         return finish_episode(ep, specs, crash_step, crashed, router,
-                              outs, jdir, steps)
+                              outs, jdir, steps,
+                              scale_events=scale_events, carried=carried)
     finally:
         if prev is None:
             os.environ.pop("DS_FAULT", None)
@@ -174,8 +266,9 @@ def run_episode(engine, vocab, ep: int, seed: int, n_replicas: int,
 
 
 def finish_episode(ep, specs, crash_step, crashed, router, outs, jdir,
-                   steps) -> dict:
-    from deepspeed_tpu.inference.serving import replay_journal
+                   steps, scale_events=(), carried=None) -> dict:
+    from deepspeed_tpu.inference.serving import (replay_journal,
+                                                 replay_scale_state)
 
     by_state = {}
     for o in outs.values():
@@ -212,11 +305,36 @@ def finish_episode(ep, specs, crash_step, crashed, router, outs, jdir,
             _check(ent.tokens == o.tokens,
                    f"journal watermark diverges for {fid}",
                    (ent.tokens, o.tokens))
+    # 5. the journal's scale fold matches the live membership: no
+    # transition left open, every closed decision reflected in the
+    # fleet (no ghost replicas, no half-retired slots)
+    router.journal.flush()
+    scale_fold = replay_scale_state(jdir)
+    for ridx, st in scale_fold.items():
+        _check(st["pending"] is None,
+               f"scale transition left open for replica {ridx}", st)
+        if st["active"] is False:
+            _check(ridx < len(router.replicas)
+                   and router.replicas[ridx].retired,
+                   f"journal says replica {ridx} scaled in, but the "
+                   f"live slot is not retired", st)
+        elif st["active"] is True:
+            _check(ridx < len(router.replicas)
+                   and not router.replicas[ridx].retired,
+                   f"journal says replica {ridx} scaled out, but the "
+                   f"live fleet has no such active slot", st)
+    c = carried or {}
+    m = router.metrics
     return {"episode": ep, "schedule": specs, "crash_step": crash_step,
             "crashed": crashed, "steps": steps, "by_state": by_state,
-            "requeued": router.metrics.requests_requeued,
-            "recovered": router.metrics.requests_recovered,
-            "kills": router.metrics.replica_kills}
+            "scale_events": list(scale_events),
+            "requeued": m.requests_requeued + c.get("requeued", 0),
+            "recovered": m.requests_recovered + c.get("recovered", 0),
+            "kills": m.replica_kills + c.get("kills", 0),
+            "scale_outs": m.scale_outs + c.get("scale_outs", 0),
+            "scale_ins": m.scale_ins + c.get("scale_ins", 0),
+            "scale_aborts": m.scale_aborts + c.get("scale_aborts", 0),
+            "replicas_final": len(router.replicas)}
 
 
 def run_episodes(episodes: int, seed: int, n_replicas: int = 2,
@@ -290,6 +408,9 @@ def main():
         "kills": sum(r["kills"] for r in results),
         "requeued": sum(r["requeued"] for r in results),
         "recovered": sum(r["recovered"] for r in results),
+        "scale_outs": sum(r["scale_outs"] for r in results),
+        "scale_ins": sum(r["scale_ins"] for r in results),
+        "scale_aborts": sum(r["scale_aborts"] for r in results),
         "wall_s": round(wall, 2),
         "verdict": "all invariants green",
     }), flush=True)

@@ -70,7 +70,12 @@ HIGHER_IS_BETTER = ("speedup", "throughput", "tokens_per_sec", "hit_rate",
                     # dropping accept rate or tokens-per-verify-step is a
                     # drafting/acceptance regression (decode_tokens_per_sec
                     # and *_speedup already match the rules above)
-                    "accept_rate", "spec_tokens_per_verify")
+                    "accept_rate", "spec_tokens_per_verify",
+                    # elastic autoscaling (r17): SLO-good tokens per
+                    # replica-step burned — step-denominated on a fixed
+                    # seeded schedule, so the aggregate is deterministic
+                    # and a drop is a real policy/efficiency regression
+                    "goodput_per_replica_step")
 
 LOWER_IS_BETTER = ("ttft", "latency", "wall", "overhead", "shed_rate",
                    "timeout_rate", "step_p", "evictions",
@@ -143,7 +148,17 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         "token_match", "max_rel_err", "bytes_ratio", "fp_bytes",
         ".leaves", ".group", "comm_mix", "wire_bytes_ratio",
         "parity_band", "psum_block", "quant_sweep.modes.",
-        "quant_sweep.fp_decode_tokens_per_sec")
+        "quant_sweep.fp_decode_tokens_per_sec",
+        # elastic autoscaling (r17): the per-arm internals are the
+        # SCHEDULE's volume and the policy's configuration — the
+        # acceptance bar (autoscale >= EVERY fixed arm on goodput-per-
+        # replica-step) is asserted in-bench, and the per-arm walls /
+        # wall TTFTs fold compile placement and 1-core box noise. The
+        # gated r17 signals are the two step-denominated aggregates
+        # (autoscale_/best_fixed_goodput_per_replica_step, higher-is-
+        # better above); scale_storm counters are the storm schedule's.
+        "autoscale_sweep.arms.", "peak_replicas", "flash_requests",
+        "horizon_steps", "ttft_slo_steps", "scale_storm.")
 
 
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
