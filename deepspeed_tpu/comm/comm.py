@@ -194,7 +194,8 @@ class CommObserver:
         return self._registry_ref() if self._registry_ref is not None \
             else None
 
-    def emit(self, op: str, x, axis: AxisName, t0: float) -> None:
+    def emit(self, op: str, x, axis: AxisName, t0: float,
+             tag: str = "") -> None:
         t1 = time.perf_counter()
         tr = self.tracer
         reg = self.registry
@@ -207,9 +208,14 @@ class CommObserver:
         nbytes = _nbytes(x)
         dtype = str(getattr(x, "dtype", "?"))
         if tr is not None and tr.enabled:
-            tr.complete(f"comm:{op}", t0, t1, cat="comm",
-                        args={"op": op, "bytes": nbytes, "dtype": dtype,
-                              "axis": str(axis)})
+            args = {"op": op, "bytes": nbytes, "dtype": dtype,
+                    "axis": str(axis)}
+            if tag:
+                # async start/done pairs label their bucket so the
+                # flight recorder can match the two edges of one
+                # collective (trace_view --comm-pairs)
+                args["tag"] = tag
+            tr.complete(f"comm:{op}", t0, t1, cat="comm", args=args)
         if reg is not None:
             bucket = _bytes_bucket(nbytes)
             key = (op, dtype, bucket)
@@ -330,6 +336,100 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"
         out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True)
     if t0:
         comm_observer.emit("reduce_scatter", tensor, group, t0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Async collective pairs (start/done) — the grad-overlap seam
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class AsyncCollectiveHandle:
+    """In-flight result of a ``*_start`` verb.
+
+    Counterpart of the reference's ``async_op=True`` work handles
+    (``deepspeed/comm/comm.py`` returns a ``Work`` whose ``.wait()``
+    blocks). Under SPMD there is no host-side wait: ``start`` *stages*
+    the collective into the program, and the matching ``done`` verb is
+    the synchronization point — it pins the data dependence through
+    ``lax.optimization_barrier`` so XLA cannot sink the collective past
+    it, while everything *between* start and done is free for the
+    latency-hiding scheduler to overlap with the in-flight transfer.
+    An orphaned handle (start without done) is a program with an
+    unconsumed collective — dead on TPU; the ``comm-start-done`` dslint
+    rule rejects it statically and ``trace_view --comm-pairs`` checks
+    the recorded spans at runtime.
+    """
+
+    __slots__ = ("value", "op", "axis", "tag")
+
+    def __init__(self, value, op: str = "", axis: AxisName = "data",
+                 tag: str = ""):
+        self.value = value
+        self.op = op
+        self.axis = axis
+        self.tag = tag
+
+    def tree_flatten(self):
+        return (self.value,), (self.op, self.axis, self.tag)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+def reduce_scatter_start(tensor, op: ReduceOp = ReduceOp.SUM,
+                         group: AxisName = "data",
+                         scatter_dimension: int = 0, tag: str = ""):
+    """Launch a tiled reduce-scatter; pair with ``reduce_scatter_done``.
+
+    ``tag`` labels the pair in tracer spans (grad buckets use
+    ``grad_bucket<i>``), so per-bucket wire time is attributable.
+    """
+    if op != ReduceOp.SUM:
+        raise NotImplementedError(
+            "async reduce_scatter supports SUM on the XLA backend")
+    _record("reduce_scatter_start", tensor, group)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.psum_scatter(tensor, group,
+                           scatter_dimension=scatter_dimension, tiled=True)
+    if t0:
+        comm_observer.emit("reduce_scatter_start", tensor, group, t0, tag=tag)
+    return AsyncCollectiveHandle(out, "reduce_scatter", group, tag)
+
+
+def reduce_scatter_done(handle: AsyncCollectiveHandle):
+    """Synchronize a ``reduce_scatter_start``: returns the reduced shard."""
+    _record("reduce_scatter_done", handle.value, handle.axis)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.optimization_barrier(handle.value)
+    if t0:
+        comm_observer.emit("reduce_scatter_done", handle.value, handle.axis,
+                           t0, tag=handle.tag)
+    return out
+
+
+def all_gather_start(tensor, group: AxisName = "data", axis: int = 0,
+                     tiled: bool = False, tag: str = ""):
+    """Launch an all-gather; pair with ``all_gather_done`` (the ZeRO-1
+    post-update param gather uses ``param_bucket<i>`` tags)."""
+    _record("all_gather_start", tensor, group)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+    if t0:
+        comm_observer.emit("all_gather_start", tensor, group, t0, tag=tag)
+    return AsyncCollectiveHandle(out, "all_gather", group, tag)
+
+
+def all_gather_done(handle: AsyncCollectiveHandle):
+    """Synchronize an ``all_gather_start``: returns the gathered tensor."""
+    _record("all_gather_done", handle.value, handle.axis)
+    t0 = time.perf_counter() if comm_observer.enabled else 0.0
+    out = lax.optimization_barrier(handle.value)
+    if t0:
+        comm_observer.emit("all_gather_done", handle.value, handle.axis,
+                           t0, tag=handle.tag)
     return out
 
 

@@ -214,13 +214,24 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
             and opt_cfg.type.lower() in ("onebitadam", "onebitlamb",
                                          "zerooneadam")
             and (opt_cfg.params or {}).get("comm_backend_name") == "compressed")
-        self.optimizer = None if (self._offload or self._onebit_wire) \
-            else self._build_optimizer()
-        if self._config.sparse_gradients_enabled and (self._offload
-                                                      or self._onebit_wire):
-            raise ValueError("sparse_gradients does not compose with "
+        #: explicit bucketed reduce-scatter overlap + ZeRO-1 sharded
+        #: update (runtime/zero/overlap.py) — opt-in via
+        #: zero_optimization.overlap_grad_sync
+        self._overlap_lane = bool(self._config.zero_config.overlap_grad_sync)
+        if self._overlap_lane and (self._offload or self._onebit_wire):
+            raise ValueError("overlap_grad_sync does not compose with "
                              "offload_optimizer or wire-compressed 1-bit "
                              "training (each owns the explicit grad exchange)")
+        self.optimizer = None if (self._offload or self._onebit_wire
+                                  or self._overlap_lane) \
+            else self._build_optimizer()
+        if self._config.sparse_gradients_enabled and (self._offload
+                                                      or self._onebit_wire
+                                                      or self._overlap_lane):
+            raise ValueError("sparse_gradients does not compose with "
+                             "offload_optimizer, wire-compressed 1-bit "
+                             "training, or overlap_grad_sync (each owns the "
+                             "explicit grad exchange)")
 
         # ---- shardings (ZeRO policy) ------------------------------------
         self.param_shardings, shard_opt = state_shardings(
@@ -230,7 +241,7 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
         self.params_born_sharded = params is None
         if params is None:
             params = jax.jit(init_fn, out_shardings=self.param_shardings)(*init_args)
-        if self._offload or self._onebit_wire:
+        if self._offload or self._onebit_wire or self._overlap_lane:
             self.opt_shardings = ()
         else:
             opt_shapes = jax.eval_shape(self.optimizer.init, params_shapes)
@@ -256,10 +267,10 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                     if jnp.issubdtype(p.dtype, jnp.floating) else p, s),
                 params, self.param_shardings)
             opt_state = ()
-        elif self._onebit_wire:
+        elif self._onebit_wire or self._overlap_lane:
             self._host_opt = None
             params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
-            opt_state = ()  # built by build_onebit_wire below (needs params)
+            opt_state = ()  # built by the lane builder below (needs params)
         else:
             self._host_opt = None
             params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
@@ -374,6 +385,25 @@ class DeepSpeedEngine(_EngineCheckpointMixin):
                 step_fn,
                 in_shardings=(self.state_shardings, None, self._replicated),
                 out_shardings=(self.state_shardings, self._replicated,
+                               self._replicated),
+                donate_argnums=(0,))
+        elif self._overlap_lane:
+            # bucketed per-layer grad reduce-scatter overlap + data-axis
+            # sharded optimizer step (runtime/zero/overlap.py)
+            from .zero.overlap import build_overlap_step
+
+            opt_state, ov_shardings, step_fn = build_overlap_step(self)
+            self.opt_shardings = ov_shardings
+            self.state = self.state.replace(opt_state=jax.device_put(
+                opt_state, ov_shardings))
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=ov_shardings)
+            self._train_step_fn = step_fn
+            self._train_step = jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, None, self._replicated),
+                out_shardings=(self.state_shardings,
+                               (self._replicated, self._replicated),
                                self._replicated),
                 donate_argnums=(0,))
         elif self._config.sparse_gradients_enabled:

@@ -128,7 +128,8 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
                                     var_counter=repl)
 
     axis_tuple = axes if len(axes) > 1 else axes[0]
-    from .step_common import accumulate_local_grads, make_local_loss
+    from .step_common import (accumulate_local_grads, make_local_loss,
+                              scale_local_loss)
 
     local_loss = make_local_loss(engine)
     gas = engine.gradient_accumulation_steps
@@ -141,8 +142,7 @@ def build_onebit_wire(engine, opt_params: dict, kind: str = "onebitadam"):
         # gas > 1: LOCAL grads accumulate over microbatches (no collectives
         # inside the scan), then ONE compressed exchange per optimizer step.
         # fp16: backward runs on the SCALED loss; grads unscale right here
-        scaled_loss = (lambda p, mb, r: local_loss(p, mb, r) * lscale) \
-            if fp16 else local_loss
+        scaled_loss = scale_local_loss(local_loss, lscale, fp16)
         loss_local, g = accumulate_local_grads(scaled_loss, params, batch,
                                                rng, gas)
         if fp16:

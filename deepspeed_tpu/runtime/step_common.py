@@ -32,6 +32,16 @@ def make_local_loss(engine):
     return local_loss
 
 
+def scale_local_loss(local_loss, lscale, fp16):
+    """fp16 discipline shared by the explicit lanes (onebit / overlap):
+    backward runs on the SCALED loss, and the scaled local grads unscale
+    only after (or inside) the explicit exchange — the loss-scaler
+    contract of ``fp16/loss_scaler.py`` kept identical across lanes."""
+    if not fp16:
+        return local_loss
+    return lambda p, mb, r: local_loss(p, mb, r) * lscale
+
+
 def accumulate_local_grads(local_loss, params, batch, rng, gas):
     """(mean loss, mean grads) over ``gas`` microbatches of the LOCAL batch
     (leading dim ``gas``), via ``lax.scan`` — the in-jit GAS boundary

@@ -62,10 +62,25 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     stage: ZeroStageEnum = ZeroStageEnum.disabled
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
+    #: bucket byte threshold for the explicit grad-sync lane
+    #: (``zero/overlap.py``): grad leaves coalesce into one reduce-scatter
+    #: until the bucket holds this many bytes (reference
+    #: ``stage_1_and_2.py`` reduce buckets; same knob name/units)
     reduce_bucket_size: int = Field(500_000_000, ge=0)
     allgather_partitions: bool = True
     allgather_bucket_size: int = Field(500_000_000, ge=0)
-    overlap_comm: Optional[bool] = None  # default True for stage3 (reference)
+    #: defaults True for every sharding stage (1/2/3); the explicit
+    #: ``overlap_grad_sync`` lane honors ``overlap_comm: false`` as the
+    #: kill-switch back to one monolithic all-reduce (no async pairs)
+    overlap_comm: Optional[bool] = None
+    #: opt-in: route training through the explicit bucketed
+    #: reduce-scatter lane (``runtime/zero/overlap.py``) — per-bucket
+    #: start/done collective pairs overlapped with backward, and (for
+    #: stage>=1) the data-axis sharded optimizer update + fused param
+    #: all-gather. Off by default: the lane changes the opt_state layout
+    #: (flat per-rank chunks), which checkpoint tooling that reshapes
+    #: param-shaped moments across stages must opt into knowingly.
+    overlap_grad_sync: bool = False
     load_from_fp32_weights: bool = True
     elastic_checkpoint: bool = False
     offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
@@ -93,7 +108,11 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
         # honor either alias or field name
         super().__init__(**data)
         if self.overlap_comm is None:
-            self.overlap_comm = self.stage == ZeroStageEnum.weights
+            # every sharding stage overlaps by default (the reference
+            # defaults stage3-only; stage1/2 grew the same machinery
+            # here) — an explicit ``overlap_comm: false`` survives as
+            # the end-to-end kill-switch for the overlap lane
+            self.overlap_comm = self.stage >= ZeroStageEnum.optimizer_states
         if self.cpu_offload:
             self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
                 device=OffloadDeviceEnum.cpu, pin_memory=bool(self.cpu_offload_use_pin_memory))

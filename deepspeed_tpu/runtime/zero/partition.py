@@ -204,6 +204,53 @@ def shard_params(params: Any, shardings: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-1 flat partition (the data-axis sharded optimizer update)
+# ---------------------------------------------------------------------------
+#
+# The explicit lane (``zero/overlap.py``) shards the *flattened* param
+# space: each leaf pads to a multiple of the ZeRO world ``w`` and is viewed
+# as ``[w, c_i]`` rows — rank ``r`` owns row ``r`` of EVERY leaf (the
+# interleaved layout of reference ``stage_1_and_2.py`` flat partitions).
+# The layout is a pure function of (leaf shapes, w): bucket composition —
+# which leaves share one reduce-scatter — never changes which elements a
+# rank owns, which is what keeps the compiled step's interface (and the
+# recompile sentinel) invariant under ``reduce_bucket_size`` changes.
+
+
+def zero1_chunk_sizes(params_shapes: Any, world: int
+                      ) -> Tuple[Tuple[int, ...], Tuple[int, ...],
+                                 Tuple[int, ...]]:
+    """Per-leaf ``(sizes, padded, chunks)`` of the flat partition:
+    ``padded[i] = ceil(sizes[i]/world)*world`` and ``chunks[i] =
+    padded[i]//world`` — the per-rank share of leaf ``i``."""
+    leaves = jax.tree_util.tree_leaves(params_shapes)
+    sizes = tuple(int(np.prod(l.shape or (1,))) for l in leaves)
+    padded = tuple(-(-n // world) * world for n in sizes)
+    chunks = tuple(p // world for p in padded)
+    return sizes, padded, chunks
+
+
+def zero1_state_shardings(opt_state_shapes: Any, mesh: Mesh,
+                          axes: Sequence[str]) -> Any:
+    """Shardings for a flat-chunked optimizer state (the
+    ``state_shardings`` policy applied to the flat partition): leaves
+    carrying a leading ZeRO-world dim — the single ``[world, C_total]``
+    moment per optax leaf, C_total the concatenation of every param
+    leaf's per-rank chunk — shard dim 0 over ``axes``; scalar state
+    (step counts) replicates. One flat row per rank keeps the update a
+    single fused elementwise pass and the canonical arithmetic pipeline
+    identical across collective groupings (``zero/overlap.py``)."""
+    axes = tuple(axes)
+    row = NamedSharding(mesh, PartitionSpec(axes))
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def place(leaf):
+        return row if getattr(leaf, "ndim", 0) >= 1 else repl
+
+    return jax.tree_util.tree_map(place, opt_state_shapes)
+
+
+# ---------------------------------------------------------------------------
 # zero.Init + GatheredParameters parity API
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,186 @@
+"""comm-start-done: async collective starts must be completed on every path.
+
+The overlap lane (``runtime/zero/overlap.py``) splits collectives into
+``reduce_scatter_start`` / ``reduce_scatter_done`` pairs so the backward
+pass can run under in-flight buckets. A *start* whose handle never
+reaches the matching *done* is the worst kind of bug: the ``done`` side
+carries the ``optimization_barrier`` that fences the async region, so a
+dropped done leaves the program numerically plausible while the overlap
+contract — and on real hardware the DMA completion wait — is silently
+gone. The flight recorder shows it only as a started span that never
+closes, one profile too late. This rule is the review-time half.
+
+Inside each function, every call to a known async start verb must be
+matched by a call to the paired done verb on EVERY control-flow path
+from the start to the function's exit:
+
+- a done later in the same (or an enclosing) block counts;
+- a done only inside one arm of an ``if`` does NOT — both arms (the
+  implicit empty ``else`` included) must complete, or a later statement
+  must;
+- a ``return`` / ``raise`` reachable between start and done is flagged
+  as an early-exit leak;
+- loop bodies are treated as executing (a ``for h in handles:
+  done(h)`` drain loop completes — zero-iteration pedantry would flag
+  every legitimate drain of a possibly-empty bucket list, and an empty
+  handle list has nothing to leak);
+- a ``try`` completes when its ``finally`` (or its body AND every
+  handler) completes.
+
+Matching is by verb NAME within one function body, not by handle value —
+data flow through pytrees is out of AST reach, but every in-tree usage
+(and every reasonable one) starts and drains its handles in the same
+function, so name-level pairing is exactly the contract. Helpers that
+intentionally hand a live handle to their caller earn an explicit
+``# dslint: ignore[comm-start-done] <why>``.
+"""
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .core import FileCtx, Finding
+
+#: collective verbs with an async start/done pair in ``comm/comm.py``
+#: (the comm module's public async surface — extend when a verb grows a
+#: pair; unknown ``foo_start`` names are NOT collective starts).
+ASYNC_VERBS = ("reduce_scatter", "all_gather", "all_reduce", "broadcast",
+               "all_to_all", "reduce", "gather", "scatter", "send", "recv")
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+_COMPOUND = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+             ast.AsyncWith, ast.Try)
+
+
+def _call_verb(node: ast.Call, suffix: str) -> Optional[str]:
+    """The async verb base when ``node`` calls ``<verb><suffix>`` (bare
+    name or any-module attribute), else None."""
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name is None or not name.endswith(suffix):
+        return None
+    base = name[: -len(suffix)]
+    return base if base in ASYNC_VERBS else None
+
+
+def _own_verbs(stmt: ast.stmt, suffix: str) -> Set[str]:
+    """Verbs called with ``suffix`` in ``stmt``'s OWN expressions: not in
+    child statement blocks (those are separate control-flow nodes) and
+    not in nested function/class scopes (deferred code, not this path).
+    Comprehensions execute in place and are included."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(node, (ast.stmt,) + _SCOPES):
+            continue
+        if isinstance(node, ast.Call):
+            verb = _call_verb(node, suffix)
+            if verb is not None:
+                out.add(verb)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _flow(stmts: List[ast.stmt], verb: str) -> Tuple[bool, bool]:
+    """Coverage walk for ``<verb>_done`` over a statement list.
+
+    Returns ``(falls, escapes)``: *falls* — some path falls off the end
+    without having executed a done; *escapes* — some path leaves the
+    function (return/raise) without one. Paths stop counting after
+    their first guaranteed done.
+    """
+    falls, escapes = True, False
+    for stmt in stmts:
+        if not falls:
+            break
+        if verb in _own_verbs(stmt, "_done"):
+            falls = False
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            escapes = True
+            falls = False
+        elif isinstance(stmt, ast.If):
+            f1, e1 = _flow(stmt.body, verb)
+            f2, e2 = _flow(stmt.orelse, verb) if stmt.orelse else (True, False)
+            falls = f1 or f2
+            escapes = escapes or e1 or e2
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # drain-loop reading (module docstring): a completing body
+            # counts — zero iterations implies zero outstanding handles
+            fb, eb = _flow(stmt.body, verb)
+            fo, eo = _flow(stmt.orelse, verb) if stmt.orelse else (True, False)
+            falls = fb and fo
+            escapes = escapes or eb or eo
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            falls, eb = _flow(stmt.body, verb)
+            escapes = escapes or eb
+        elif isinstance(stmt, ast.Try):
+            fb, eb = _flow(stmt.body, verb)
+            ff, ef = (_flow(stmt.finalbody, verb) if stmt.finalbody
+                      else (True, False))
+            fh = eh = False
+            for handler in stmt.handlers:
+                f, e = _flow(handler.body, verb)
+                fh, eh = fh or f, eh or e
+            if not ff:          # finally always completes → path is done
+                falls = False
+                escapes = escapes or ef
+            else:
+                falls = fb or fh
+                escapes = escapes or eb or eh or ef
+    return falls, escapes
+
+
+def _scan_block(ctx: FileCtx, chain: List[Tuple[List[ast.stmt], int]],
+                stmts: List[ast.stmt], out: List[Finding]) -> None:
+    """Check every start in ``stmts``; ``chain`` is the list of
+    (enclosing block, index of the statement containing us) from the
+    function body down — the tails that may still complete a start."""
+    for i, stmt in enumerate(stmts):
+        started = _own_verbs(stmt, "_start")
+        for verb in sorted(started):
+            if verb in _own_verbs(stmt, "_done"):
+                continue        # start+done in one statement
+            covered = False
+            leak_escape = False
+            tails = [stmts[i + 1:]] + \
+                [blk[j + 1:] for blk, j in reversed(chain)]
+            for tail in tails:
+                falls, escapes = _flow(tail, verb)
+                leak_escape = leak_escape or escapes
+                if not falls:
+                    covered = True
+                    break
+            if not covered:
+                out.append(ctx.finding(
+                    stmt, "comm-start-done",
+                    f"async {verb}_start without a matching "
+                    f"{verb}_done on every path to function exit"))
+            elif leak_escape:
+                out.append(ctx.finding(
+                    stmt, "comm-start-done",
+                    f"a return/raise between {verb}_start and its "
+                    f"{verb}_done leaks the in-flight collective on "
+                    f"that path"))
+        if isinstance(stmt, _COMPOUND):
+            for child in _child_blocks(stmt):
+                _scan_block(ctx, chain + [(stmts, i)], child, out)
+
+
+def _child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, field, None)
+        if blk:
+            out.append(list(blk))
+    for handler in getattr(stmt, "handlers", None) or []:
+        out.append(list(handler.body))
+    return out
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_block(ctx, [], node.body, out)
+    return out

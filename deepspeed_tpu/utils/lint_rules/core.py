@@ -143,6 +143,18 @@ RULES: Dict[str, Dict[str, str]] = {
                 "the seeded jax PRNG streams",
         "counterpart": "non-reproducible traces / fingerprint drift",
     },
+    "comm-start-done": {
+        "family": "comm-pairs",
+        "what": "async collective <verb>_start without a matching "
+                "<verb>_done on every control-flow path to function "
+                "exit (or a return/raise between the pair)",
+        "hint": "drain every started collective in the same function — "
+                "the done side carries the optimization_barrier that "
+                "fences the async region; a handle handed to the caller "
+                "on purpose earns an ignore pragma with the reason",
+        "counterpart": "flight-recorder span that starts and never "
+                       "closes; dropped DMA completion wait on hardware",
+    },
     "bad-pragma": {
         "family": "pragma",
         "what": "malformed dslint pragma, unknown rule id, or ignore "
@@ -407,7 +419,7 @@ def run_lint(paths: Sequence[str],
     guarded-field annotations from EVERY file (cross-module discipline —
     the scrape path reads engine fields from monitor code), then run the
     rule checkers. ``select`` restricts to a subset of rule ids (tests)."""
-    from . import serving_rules, threads, trace_safety
+    from . import comm_pairs, serving_rules, threads, trace_safety
 
     ctxs: List[FileCtx] = []
     findings: List[Finding] = []
@@ -432,6 +444,7 @@ def run_lint(paths: Sequence[str],
         findings.extend(trace_safety.check(ctx))
         findings.extend(threads.check(ctx, guarded))
         findings.extend(serving_rules.check(ctx))
+        findings.extend(comm_pairs.check(ctx))
 
     if select is not None:
         findings = [f for f in findings if f.rule in select]

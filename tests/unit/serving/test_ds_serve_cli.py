@@ -37,6 +37,8 @@ def test_malformed_jsonl_error_records_nonzero_exit(tmp_path):
     assert "tokenizer" in recs[4]["error"]
 
 
+@pytest.mark.slow  # tracing covered fast in-process; demo CLI keeps
+                   # replicas/admin-port as the subprocess representatives
 def test_demo_trace_dir_writes_perfetto_trace_and_stats(tmp_path):
     """The observability acceptance path: a --demo --trace-dir run must
     leave a Perfetto-loadable trace with complete per-request timelines,
@@ -159,6 +161,7 @@ def test_admin_port_live_process_answers_control_plane(tmp_path):
     assert "goodput_tok/s=" not in out  # stats line stays on stderr
 
 
+@pytest.mark.slow  # speculation covered fast by test_speculative.py
 def test_spec_tokens_demo_reports_speculation(tmp_path):
     """--spec-tokens arms prompt-lookup speculation end to end through
     the CLI: the run serves, the stats line carries acceptance, and the
@@ -181,6 +184,7 @@ def test_spec_tokens_demo_reports_speculation(tmp_path):
     assert "spec_acc=" in r.stderr, "stats line must carry acceptance"
 
 
+@pytest.mark.slow  # tiers covered fast by test_kv_tiers.py
 def test_host_cache_demo_reports_tier_table(tmp_path):
     """--host-cache-blocks end-to-end: the demo serves with the host
     spill tier armed (implying --prefix-cache), the stats line carries
@@ -241,6 +245,8 @@ def test_replicas_demo_serves_fleet_and_reports(tmp_path):
     assert all(rec["served_on"] for rec in results)
 
 
+@pytest.mark.slow  # journal + recovery covered fast in-process
+                   # (test_journal.py, fleet recovery tests)
 def test_journal_dir_demo_durable_and_restart_recovers_nothing(tmp_path):
     """--journal-dir serves through a journaled 1-replica fleet: the
     final report carries the journal block, records show recovered

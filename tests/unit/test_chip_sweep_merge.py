@@ -88,3 +88,38 @@ def test_plan_impl_mapping_covers_every_decode_step():
                "decode_pallas_int8": "pallas_int8", "decode_mixtral": "xla"}
     for name in plan_names:
         assert name in mapping, name
+
+
+def test_dry_run_prints_plan_without_probing(tmp_path):
+    """--dry-run must never touch the backend (it runs on dev boxes with
+    no chip): plan JSON on stdout, rc 0, and the PR 19 explicit-lane
+    arms present with their artifacts."""
+    proc = subprocess.run(
+        [sys.executable, "tools/chip_sweep.py", "--dry-run", "--tag",
+         "rSMOKE"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    plan = json.loads(proc.stdout)
+    assert plan["dry_run"] is True
+    by_name = {s["name"]: s for s in plan["steps"]}
+    assert "overlap_grad_sync" in by_name
+    assert "zero1_sharded_update" in by_name
+    assert by_name["overlap_grad_sync"]["artifact"] == "OVERLAP_rSMOKE.json"
+    assert by_name["zero1_sharded_update"]["artifact"] == "ZERO1_rSMOKE.json"
+    assert "--lane" in by_name["overlap_grad_sync"]["cmd"]
+    # probing leaves a state file / backend log — dry-run must not
+    assert not os.path.exists(os.path.join(
+        REPO, "CHIP_SWEEP_STATE_rSMOKE.json"))
+
+
+def test_dry_run_respects_skip_prefixes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "tools/chip_sweep.py", "--dry-run", "--tag", "rS",
+         "--skip", "overlap,zero1,decode"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    names = {s["name"] for s in json.loads(proc.stdout)["steps"]}
+    assert "overlap_grad_sync" not in names
+    assert "zero1_sharded_update" not in names
+    assert not any(n.startswith("decode") for n in names)
+    assert "bench" in names

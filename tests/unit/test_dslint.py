@@ -866,3 +866,121 @@ def test_lock_snapshot_name_reuse_in_unrelated_class_is_quiet(tmp_path):
     hits = rules_at(report, "lock-snapshot")
     assert len(hits) == 1
     assert hits[0].path.endswith("eng.py")
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: comm-pairs (async collective start/done discipline)
+# ---------------------------------------------------------------------------
+
+def test_comm_start_done_clean_patterns(tmp_path):
+    """The in-tree shapes stay quiet: list-comp start + drain loop,
+    monolithic (no start), start+done in one statement, done in an
+    enclosing block, and a try whose finally drains."""
+    report = lint_src(tmp_path, """
+    def bucketed(dist, bufs):
+        handles = [dist.reduce_scatter_start(b) for b in bufs]
+        return [dist.reduce_scatter_done(h) for h in handles]
+
+    def drain_loop(dist, bufs):
+        hs = [dist.all_gather_start(b) for b in bufs]
+        out = []
+        for h in hs:
+            out.append(dist.all_gather_done(h))
+        return out
+
+    def one_liner(dist, x):
+        return dist.reduce_scatter_done(dist.reduce_scatter_start(x))
+
+    def branch_then_join(dist, x, fancy):
+        h = dist.all_reduce_start(x)
+        if fancy:
+            x = x * 2
+        return dist.all_reduce_done(h)
+
+    def finally_drains(dist, x):
+        h = dist.broadcast_start(x)
+        try:
+            x = x + 1
+        finally:
+            x = dist.broadcast_done(h)
+        return x
+
+    def not_a_collective(engine):
+        engine.timer_start()  # no paired verb: out of scope
+    """)
+    assert not rules_at(report, "comm-start-done")
+
+
+def test_comm_start_without_done_flagged(tmp_path):
+    report = lint_src(tmp_path, """
+    def leaky(dist, bufs):
+        handles = [dist.reduce_scatter_start(b) for b in bufs]
+        return handles
+    """)
+    hits = rules_at(report, "comm-start-done")
+    assert len(hits) == 1
+    assert "reduce_scatter_done" in hits[0].message
+
+
+def test_comm_done_only_in_one_branch_flagged(tmp_path):
+    """A done inside one arm of an if does not cover the other arm."""
+    report = lint_src(tmp_path, """
+    def half_drained(dist, x, flag):
+        h = dist.all_gather_start(x)
+        if flag:
+            x = dist.all_gather_done(h)
+        return x
+
+    def both_arms_ok(dist, x, flag):
+        h = dist.all_gather_start(x)
+        if flag:
+            x = dist.all_gather_done(h)
+        else:
+            x = dist.all_gather_done(h) * 2
+        return x
+    """)
+    hits = rules_at(report, "comm-start-done")
+    assert len(hits) == 1
+    assert hits[0].func == "half_drained"
+
+
+def test_comm_early_return_between_pair_flagged(tmp_path):
+    report = lint_src(tmp_path, """
+    def early_exit(dist, x, bad):
+        h = dist.reduce_scatter_start(x)
+        if bad:
+            return None
+        return dist.reduce_scatter_done(h)
+    """)
+    hits = rules_at(report, "comm-start-done")
+    assert len(hits) == 1
+    assert "return/raise" in hits[0].message
+
+
+def test_comm_nested_def_done_does_not_count(tmp_path):
+    """A done inside a nested def is deferred code, not execution on
+    this path — the start is still unmatched."""
+    report = lint_src(tmp_path, """
+    def outer(dist, x):
+        h = dist.all_to_all_start(x)
+
+        def later():
+            return dist.all_to_all_done(h)
+
+        return later
+    """)
+    hits = rules_at(report, "comm-start-done")
+    assert len(hits) == 1
+
+
+def test_comm_start_done_pragma_and_catalog(tmp_path):
+    """Intentional handle handoff is exempted with a reasoned pragma,
+    and the rule is in the shipped catalog."""
+    assert "comm-start-done" in RULES
+    report = lint_src(tmp_path, """
+    def handoff(dist, x):
+        # dslint: ignore[comm-start-done] caller drains via AsyncHandle API
+        return dist.reduce_scatter_start(x)
+    """)
+    assert not rules_at(report, "comm-start-done")
+    assert report.suppressed

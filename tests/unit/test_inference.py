@@ -39,7 +39,10 @@ def _tiny_llama_hf(seed=0):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("family", ["llama", "gpt2"])
+@pytest.mark.parametrize("family", [
+    "llama",  # fast representative; gpt2 cached decode also rides the
+              # serving gpt2 and decode-wiring suites
+    pytest.param("gpt2", marks=pytest.mark.slow)])
 @pytest.mark.parametrize("scan_layers", [
     pytest.param(True, marks=pytest.mark.slow), False])
 def test_cached_decode_matches_full_forward(family, scan_layers):

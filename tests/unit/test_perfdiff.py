@@ -202,3 +202,88 @@ def test_classify_directions():
     assert perfdiff.classify("tracing_overhead.overhead_pct") == "abs_bar"
     assert perfdiff.classify("meta.device_count") is None
     assert perfdiff.classify("prefix_hits") is None
+
+
+# ---------------------------------------------------------------------------
+# training BENCH artifacts: JSON-lines rows, _ms direction, lifted meta
+# ---------------------------------------------------------------------------
+
+TRAIN_ROWS = [
+    {"meta": META},
+    {"tag": "overlap_grad_sync", "step_ms": 12.0, "fwdbwd_ms": 9.0,
+     "overlap_speedup": 1.2, "full_tflops": 21.0,
+     "compile_counts": {"train_step": 1}, "recompiles": 0},
+    {"tag": "zero1_sharded_update", "step_ms": 11.5, "fwdbwd_ms": 9.1,
+     "full_tflops": 22.0, "compile_counts": {"train_step": 1},
+     "recompiles": 0},
+]
+
+
+def _write_lines(tmp_path, name, rows):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_training_jsonl_self_compare_gates_green(tmp_path, capsys):
+    a = _write_lines(tmp_path, "a.json", TRAIN_ROWS)
+    b = _write_lines(tmp_path, "b.json", TRAIN_ROWS)
+    assert perfdiff.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "no regressions" in out
+
+
+def test_training_ms_regression_gates_red(tmp_path, capsys):
+    worse = copy.deepcopy(TRAIN_ROWS)
+    worse[1]["step_ms"] = 20.0       # +67% on a lower-is-better _ms key
+    a = _write_lines(tmp_path, "a.json", TRAIN_ROWS)
+    b = _write_lines(tmp_path, "b.json", worse)
+    assert perfdiff.main([a, b]) == 1
+    assert "step_ms" in capsys.readouterr().err
+
+
+def test_training_tflops_drop_gates_red(tmp_path):
+    worse = copy.deepcopy(TRAIN_ROWS)
+    worse[1]["full_tflops"] = 10.0   # -52% on a higher-is-better key
+    a = _write_lines(tmp_path, "a.json", TRAIN_ROWS)
+    b = _write_lines(tmp_path, "b.json", worse)
+    assert perfdiff.main([a, b]) == 1
+
+
+def test_training_compile_count_growth_gates_red(tmp_path):
+    worse = copy.deepcopy(TRAIN_ROWS)
+    worse[2]["compile_counts"]["train_step"] = 2
+    a = _write_lines(tmp_path, "a.json", TRAIN_ROWS)
+    b = _write_lines(tmp_path, "b.json", worse)
+    assert perfdiff.main([a, b]) == 1
+
+
+def test_training_meta_line_lifts_and_refuses_cross_device(tmp_path, capsys):
+    """The standalone {"meta": ...} line is the artifact's provenance:
+    a missing meta line or differing device refuses exactly like the
+    serving artifacts."""
+    no_meta = TRAIN_ROWS[1:]
+    a = _write_lines(tmp_path, "a.json", TRAIN_ROWS)
+    b = _write_lines(tmp_path, "b.json", no_meta)
+    assert perfdiff.main([a, b]) == 2
+    other = copy.deepcopy(TRAIN_ROWS)
+    other[0] = {"meta": dict(META, device_kind="TPU v5e")}
+    c = _write_lines(tmp_path, "c.json", other)
+    assert perfdiff.main([a, c]) == 2
+    assert perfdiff.main([a, c, "--force"]) in (0, 1)
+
+
+def test_ms_suffix_classification():
+    assert perfdiff.classify("rows.lane.step_ms") == "lower"
+    assert perfdiff.classify("rows.lane.fwdbwd_ms.p95") == "lower"
+    assert perfdiff.classify("rows.lane.full_tflops") == "higher"
+    assert perfdiff.classify("rows.lane.items") is None
+
+
+def test_committed_profile_artifact_loads_as_rows():
+    art = os.path.join(REPO, "PROFILE_r04_cpu.json")
+    if not os.path.exists(art):
+        pytest.skip("PROFILE_r04_cpu.json not committed")
+    doc = perfdiff.load_artifact(art)
+    assert doc["rows"]
+    assert all("fwd_ms" in r for r in doc["rows"].values())

@@ -242,10 +242,13 @@ def main():
     ap.add_argument("--tag", default="r04")
     ap.add_argument("--skip", default="",
                     help="comma list: bench,decode,kernels,profile,"
-                         "infinity,longctx")
+                         "overlap,zero1,infinity,longctx")
     ap.add_argument("--resume", action="store_true",
                     help="skip steps already captured ok (state file)")
     ap.add_argument("--probe_s", type=float, default=60.0)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the step plan (names, caps, artifacts) "
+                         "as JSON and exit without probing the backend")
     args = ap.parse_args()
     skip = set(filter(None, args.skip.split(",")))
     py = sys.executable
@@ -260,15 +263,6 @@ def main():
     def save_state():
         with open(state_path, "w") as f:
             json.dump(state, f, indent=1)
-
-    log(f"chip_sweep: probing backend ({args.probe_s:.0f}s deadline)")
-    info = probe(py, args.probe_s)
-    if info is None:
-        print(json.dumps({"metric": "chip_sweep", "tag": t,
-                          "backend": "unavailable", "steps": steps}),
-              flush=True)
-        return 1
-    log(f"chip_sweep: backend UP: {info}")
 
     # money-first order; caps sized so the headline survives a short window
     plan = [
@@ -290,6 +284,15 @@ def main():
         ("kernels", None, None, f"KERNELS_{t}.json"),  # per-kernel splitter
         ("profile", [py, "tools/profile_train.py", "--quick"], 1200,
          f"PROFILE_{t}.json"),
+        # explicit-lane evidence (PR 19): bucketed per-layer reduce-scatter
+        # overlap vs kill-switch vs fused, and the ZeRO-1 data-axis sharded
+        # optimizer update — each one artifact gateable by perfdiff
+        ("overlap_grad_sync",
+         [py, "tools/profile_train.py", "--lane", "overlap_grad_sync"],
+         900, f"OVERLAP_{t}.json"),
+        ("zero1_sharded_update",
+         [py, "tools/profile_train.py", "--lane", "zero1_sharded_update"],
+         900, f"ZERO1_{t}.json"),
         ("infinity", [py, "tools/bench_infinity.py"], 900,
          f"INFINITY_{t}_chip.json"),
         ("longctx", [py, "tools/bench_longctx.py"], 1200, f"LONGCTX_{t}.json"),
@@ -311,6 +314,24 @@ def main():
         plan.insert(1, ("bench_v2",
                         ["env", "DS_BENCH_BUDGET_S=900", py, "bench.py"],
                         1100, f"BENCH_{t}_v2.json"))
+    if args.dry_run:
+        print(json.dumps({
+            "metric": "chip_sweep_plan", "tag": t, "dry_run": True,
+            "steps": [{"name": n, "cmd": c, "cap_s": cap, "artifact": a}
+                      for n, c, cap, a in plan
+                      if n.split("_")[0] not in skip]}, indent=1),
+            flush=True)
+        return 0
+
+    log(f"chip_sweep: probing backend ({args.probe_s:.0f}s deadline)")
+    info = probe(py, args.probe_s)
+    if info is None:
+        print(json.dumps({"metric": "chip_sweep", "tag": t,
+                          "backend": "unavailable", "steps": steps}),
+              flush=True)
+        return 1
+    log(f"chip_sweep: backend UP: {info}")
+
     backend_lost = False
     for name, cmd, cap, artifact in plan:
         if name.split("_")[0] in skip:

@@ -30,9 +30,17 @@ Direction rules (matched on the flattened dotted key, first hit wins):
 - *higher-is-better*: speedup / throughput / tokens_per_sec / hit_rate /
   mfu / mbu / bandwidth / tflops;
 - *lower-is-better*: ttft / latency / wall / overhead / shed_rate /
-  timeout_rate / keys ending in ``_s`` or percentile legs under them;
+  timeout_rate / keys ending in ``_s`` or ``_ms`` (the training
+  breakdown artifacts' unit) or percentile legs under them;
 - everything else is informational (printed with ``--verbose``, never
   gates).
+
+Training BENCH artifacts are JSON-LINES (one record per configuration —
+``tools/profile_train.py``, the chip-sweep lane arms): both inputs are
+loaded either as a single JSON document or as JSON-lines, where rows key
+by their ``tag``/``metric`` field and a standalone ``{"meta": ...}``
+line (``perf_meta``) lifts to the document's meta block, so the
+cross-device refusal covers training artifacts too.
 
 The band: lower-is-better regresses when ``cand > base * (1 + tol)``;
 higher-is-better when ``cand < base * (1 - tol)``. A zero baseline
@@ -161,6 +169,50 @@ SKIP = ("meta.", "world", "requests", "prefix_len", "tail_len", "new_tokens",
         "horizon_steps", "ttft_slo_steps", "scale_storm.")
 
 
+def load_artifact(path: str) -> Dict[str, Any]:
+    """A bench artifact as one JSON document.
+
+    Single-doc JSON loads as-is. JSON-lines (the training breakdown
+    tools print one record per configuration) folds into ``{"rows":
+    {tag: record}}``; a standalone ``{"meta": ...}`` line — the
+    ``perf_meta`` provenance block the lane arms emit first — lifts to
+    the top level so ``check_meta`` can refuse cross-device diffs on
+    training artifacts exactly as on serving ones. Row keys come from
+    the record's ``tag`` (or ``metric``) with dots flattened out, so a
+    config rename — not a reorder — is what changes a metric's key.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    rows: Dict[str, Any] = {}
+    meta: Optional[Dict[str, Any]] = None
+    n = 0
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj.get("meta"), dict) and len(obj) == 1:
+            meta = obj["meta"]
+            continue
+        key = str(obj.get("tag") or obj.get("metric") or n).replace(".", "_")
+        rows[key] = obj
+        n += 1
+    if not rows:
+        raise json.JSONDecodeError("no JSON document or JSON-lines rows",
+                                   text[:80], 0)
+    doc: Dict[str, Any] = {"rows": rows}
+    if meta is not None:
+        doc["meta"] = meta
+    return doc
+
+
 def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
     """Numeric leaves of a JSON document as {dotted.key: float}."""
     out: Dict[str, float] = {}
@@ -188,10 +240,13 @@ def classify(key: str) -> Optional[str]:
         return "never_increase"
     if any(s in low for s in HIGHER_IS_BETTER):
         return "higher"
-    if any(s in low for s in LOWER_IS_BETTER) or low.endswith("_s") \
-            or low.endswith("_s.p50") or low.endswith("_s.p95") \
-            or low.endswith("_s.p99") or low.endswith("_s.max"):
+    if any(s in low for s in LOWER_IS_BETTER):
         return "lower"
+    for suf in ("_s", "_ms"):       # seconds and the training tools' ms
+        if low.endswith(suf) or any(
+                low.endswith(suf + leg)
+                for leg in (".p50", ".p95", ".p99", ".max")):
+            return "lower"
     return None
 
 
@@ -270,10 +325,8 @@ def main(argv=None) -> int:
         return 2
     base_path, cand_path = paths
     try:
-        with open(base_path) as f:
-            base = json.load(f)
-        with open(cand_path) as f:
-            cand = json.load(f)
+        base = load_artifact(base_path)
+        cand = load_artifact(cand_path)
     except (OSError, json.JSONDecodeError) as e:
         print(f"perfdiff: {e}", file=sys.stderr)
         return 2
